@@ -1,0 +1,1 @@
+"""Example physics models built on the framework."""

@@ -1,0 +1,1 @@
+"""Halo exchange, gather, stencil mapping and comm/compute overlap."""

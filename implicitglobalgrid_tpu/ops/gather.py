@@ -1,0 +1,64 @@
+"""Root gather of a field for in-situ visualization / monitoring.
+
+TPU-native counterpart of `gather!` (`/root/reference/src/gather.jl:14-66`).
+The reference hand-rolls a gather over `MPI_Isend/Irecv` with a persistent
+grow-only staging buffer and reassembles rank blocks into ``A_global`` in
+Cartesian block order.  Here the field *is already* the block-ordered global
+array (one block per device), so:
+
+* single process: gather is a host transfer (`jax.device_get`) — no
+  collective at all;
+* multi-host: the non-addressable shards are fetched with
+  `multihost_utils.process_allgather` (XLA all-gather over DCN/ICI), and only
+  the root process returns data.
+
+Like the reference, no halo de-duplication is performed — the result is the
+blocks side by side; strip halos first with `block_slice` if needed
+(the reference's examples do exactly that on the caller side,
+`/root/reference/examples/diffusion3D_multigpu_CuArrays.jl:53-54`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel import grid as _grid
+
+
+def gather(A, A_global=None, *, root: int = 0):
+    """Gather field ``A`` to the host on process ``root``.
+
+    Returns the assembled numpy array on the root process and ``None`` on all
+    other processes.  If ``A_global`` (a numpy array of matching size and
+    dtype) is given, it is filled in place on the root and ``None`` is
+    returned — the reference's ``gather!(A, A_global)`` signature.
+    """
+    import jax
+
+    _grid.check_initialized()
+    gg = _grid.global_grid()
+
+    if isinstance(A, jax.Array) and not A.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        data = np.asarray(multihost_utils.process_allgather(A, tiled=True))
+    else:
+        data = np.asarray(jax.device_get(A))
+
+    if jax.process_index() != root:
+        return None
+    if A_global is not None:
+        if A_global.size != data.size:
+            # Error contract from /root/reference/src/gather.jl:39 (local length
+            # = global length / nprocs in the global-block representation).
+            raise ValueError(
+                "The input argument A_global must be of length nprocs*length(A)"
+            )
+        if A_global.dtype != data.dtype:
+            raise ValueError(
+                f"A_global has dtype {A_global.dtype} but A has dtype {data.dtype}; "
+                "they must match."
+            )
+        np.copyto(A_global.reshape(data.shape), data)
+        return None
+    return data

@@ -1,0 +1,272 @@
+"""Communication/computation overlap — the `@hide_communication` capability.
+
+The reference ships the *mechanism* for overlap (max-priority CUDA streams per
+halo plane, `/root/reference/src/update_halo.jl:424`) and its sister package
+ParallelStencil supplies the *scheduling* (`@hide_communication`: compute the
+boundary slabs first, start the halo exchange, compute the interior while the
+exchange is in flight — reference `README.md:10`).
+
+On TPU both live in one compiled XLA program and the scheduler overlaps an
+async `collective-permute` with any compute it does not depend on.  The job
+here is to give the scheduler that freedom *structurally*: `hide_communication`
+wraps a shape-preserving local stencil update so that
+
+1. the boundary slabs of the new state are computed first (small),
+2. the halo planes are sliced from those slabs and sent (`ppermute`) —
+   depending only on the slab computation,
+3. the interior is computed as an independent op (big) that XLA schedules
+   concurrently with the in-flight collectives,
+4. slabs, interior and received planes are assembled into the final state.
+
+Corner correctness matches `update_halo`'s sequential-dimension semantics
+(`/root/reference/src/update_halo.jl:40`): the dim-``d`` send planes are
+patched with the strips received in dims ``< d`` before being sent, which is
+exactly the data the reference's dim-``d`` pack kernel reads after the
+dim-``d-1`` unpack.
+
+Contract for ``update_fn``: a pure, translation-invariant stencil update of
+its field arguments (output element ``i`` depends on input elements
+``i-radius .. i+radius``), returning the new field(s) with the same shapes.
+It is called on cropped windows of the blocks, so it must not hard-code sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..parallel import grid as _grid
+from ..parallel.topology import AXIS_NAMES, NDIMS
+from . import halo as _halo
+
+
+def hide_communication(update_fn=None, *, radius: int = 1, exchange=None):
+    """Wrap ``update_fn`` so its halo update overlaps its interior computation.
+
+    Per-block function: use inside `igg.stencil` (or compose:
+    ``igg.stencil(igg.hide_communication(step))``).  ``exchange`` optionally
+    lists which outputs get a halo update (default: every output that has a
+    halo).  Semantically equivalent to ``update_halo(*update_fn(*fields))``.
+    """
+    if update_fn is None:
+        return lambda f: hide_communication(f, radius=radius, exchange=exchange)
+
+    def wrapped(*fields):
+        return _overlapped_update(update_fn, fields, radius, exchange)
+
+    wrapped.__wrapped__ = update_fn
+    return wrapped
+
+
+def _halo_dims(shapes, gg) -> list[int]:
+    """Dimensions in which any of ``shapes`` exchanges a halo."""
+    out = []
+    for d in range(NDIMS):
+        if gg.dims[d] == 1 and not gg.periods[d]:
+            continue
+        if any(
+            d < len(s) and _halo.ol(d, shape=s, gg=gg) >= 2 for s in shapes
+        ):
+            out.append(d)
+    return out
+
+
+def _overlapped_update(update_fn, fields, radius, exchange):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    gg = _grid.global_grid()
+    fields = tuple(fields)
+
+    out_aval = jax.eval_shape(
+        update_fn, *[jax.ShapeDtypeStruct(f.shape, f.dtype) for f in fields]
+    )
+    single = not isinstance(out_aval, (tuple, list))
+    out_avals = (out_aval,) if single else tuple(out_aval)
+    out_shapes = [tuple(a.shape) for a in out_avals]
+    if exchange is None:
+        exchange_idx = [
+            i
+            for i, s in enumerate(out_shapes)
+            if any(_halo.ol(d, shape=s, gg=gg) >= 2 for d in range(len(s)))
+        ]
+    else:
+        exchange_idx = list(exchange)
+
+    hdims = _halo_dims([out_shapes[i] for i in exchange_idx], gg)
+    if not hdims:
+        out = update_fn(*fields)
+        return out
+
+    # Slab width per halo dim: wide enough to contain every exchanged field's
+    # send plane (index ol-1 / n-ol).
+    W = {
+        d: max(
+            _halo.ol(d, shape=out_shapes[i], gg=gg)
+            for i in exchange_idx
+            if d < len(out_shapes[i])
+        )
+        for d in hdims
+    }
+    for d, w in W.items():
+        for s in out_shapes:
+            if d < len(s) and s[d] < 2 * w:
+                raise ValueError(
+                    f"hide_communication: local size {s[d]} in dimension {d} is too "
+                    f"small for boundary-slab width {w}; use plain update_halo."
+                )
+        if radius > w:
+            raise ValueError(
+                f"hide_communication: stencil radius {radius} exceeds the boundary-"
+                f"slab width {w} in dimension {d}."
+            )
+
+    def crop(x, d, lo, hi):
+        return lax.slice_in_dim(x, lo, x.shape[d] - hi, axis=d)
+
+    # -- 1. boundary slabs of the new state (one pair per halo dim) ----------
+    # Input windows start at a common index (edge-aligned) and each field's
+    # window additionally includes its stagger excess over the smallest field,
+    # so cross-field index relations (e.g. Vx[1:] - Vx[:-1] vs P) hold on the
+    # windows exactly as on the full blocks.
+    slabs = {}  # d -> (lo_outs, hi_outs): tuples over outputs
+    for d in hdims:
+        w = W[d]
+        n_min = min(f.shape[d] for f in fields if d < f.ndim)
+        lo_in = [
+            lax.slice_in_dim(
+                f, 0, min(w + radius + (f.shape[d] - n_min), f.shape[d]), axis=d
+            )
+            for f in fields
+        ]
+        hi_in = [
+            lax.slice_in_dim(
+                f,
+                max(f.shape[d] - (w + radius + (f.shape[d] - n_min)), 0),
+                f.shape[d],
+                axis=d,
+            )
+            for f in fields
+        ]
+        lo_out = update_fn(*lo_in)
+        hi_out = update_fn(*hi_in)
+        lo_out = (lo_out,) if single else tuple(lo_out)
+        hi_out = (hi_out,) if single else tuple(hi_out)
+        lo_out = tuple(lax.slice_in_dim(o, 0, w, axis=d) for o in lo_out)
+        hi_out = tuple(
+            lax.slice_in_dim(o, o.shape[d] - w, o.shape[d], axis=d) for o in hi_out
+        )
+        slabs[d] = (lo_out, hi_out)
+
+    # -- 2./3. interior as one big independent op ----------------------------
+    int_in = fields
+    for d in hdims:
+        int_in = [crop(f, d, W[d] - radius, W[d] - radius) for f in int_in]
+    int_out = update_fn(*int_in)
+    int_out = (int_out,) if single else tuple(int_out)
+    int_out = [o for o in int_out]
+    for d in hdims:
+        int_out = [crop(o, d, radius, radius) for o in int_out]
+
+    # -- 4a. assemble slabs + interior ---------------------------------------
+    assembled = []
+    for i, aval in enumerate(out_avals):
+        base = jnp.zeros(aval.shape, aval.dtype)
+        off = [0] * len(aval.shape)
+        for d in hdims:
+            off[d] = W[d]
+        base = lax.dynamic_update_slice(base, int_out[i].astype(aval.dtype), off)
+        for d in hdims:
+            lo_o, hi_o = slabs[d]
+            lo_off = [0] * len(aval.shape)
+            hi_off = [0] * len(aval.shape)
+            hi_off[d] = aval.shape[d] - W[d]
+            base = lax.dynamic_update_slice(base, lo_o[i].astype(aval.dtype), lo_off)
+            base = lax.dynamic_update_slice(base, hi_o[i].astype(aval.dtype), hi_off)
+        assembled.append(base)
+
+    # -- 4b. halo exchange, sends sliced from the slabs (not the assembly) ---
+    for i in exchange_idx:
+        my_slabs = {d: (slabs[d][0][i], slabs[d][1][i]) for d in hdims}
+        assembled[i] = _exchange_from_slabs(
+            assembled[i], out_shapes[i], my_slabs, hdims, gg
+        )
+
+    return assembled[0] if single else tuple(assembled)
+
+
+def _exchange_from_slabs(A, shape, slabs, hdims, gg):
+    """Sequential per-dim exchange whose send planes depend only on the slabs
+    (plus strips received in earlier dims), so they are schedulable before the
+    interior computation finishes."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def plane_of(x, idx, d):
+        return lax.slice_in_dim(x, idx, idx + 1, axis=d)
+
+    def patch(plane, d, p_idx, received):
+        # Overwrite the strips of `plane` (a dim-d plane at index p_idx) that
+        # lie in earlier-exchanged dims' halo planes with the received values —
+        # the reference's corner carry-over (dim-d pack reads post-dim-(d-1)
+        # state, /root/reference/src/update_halo.jl:40).
+        for d2, (lo2, hi2) in received.items():
+            if d2 >= len(plane.shape):
+                continue
+            if lo2 is not None:
+                strip = plane_of(lo2, p_idx, d)
+                off = [0] * plane.ndim
+                plane = lax.dynamic_update_slice(plane, strip.astype(plane.dtype), off)
+            if hi2 is not None:
+                strip = plane_of(hi2, p_idx, d)
+                off = [0] * plane.ndim
+                off[d2] = plane.shape[d2] - 1
+                plane = lax.dynamic_update_slice(plane, strip.astype(plane.dtype), off)
+        return plane
+
+    received = {}
+    for d in hdims:
+        if d >= len(shape):
+            continue
+        o = _halo.ol(d, shape=shape, gg=gg)
+        if o < 2:
+            continue
+        n = shape[d]
+        nd = gg.dims[d]
+        periodic = bool(gg.periods[d])
+        if nd == 1 and not periodic:
+            continue
+        lo_slab, hi_slab = slabs[d]
+        w = lo_slab.shape[d]
+        send_lo = patch(plane_of(lo_slab, o - 1, d), d, o - 1, received)
+        send_hi = patch(plane_of(hi_slab, w - o, d), d, n - o, received)
+        if nd == 1:  # periodic self-neighbor: local copy
+            final_lo, final_hi = send_hi, send_lo
+        else:
+            axis = AXIS_NAMES[d]
+            perm_down = [(i, i - 1) for i in range(1, nd)]
+            perm_up = [(i, i + 1) for i in range(nd - 1)]
+            if periodic:
+                perm_down.append((0, nd - 1))
+                perm_up.append((nd - 1, 0))
+            try:
+                recv_hi = lax.ppermute(send_lo, axis, perm_down)
+                recv_lo = lax.ppermute(send_hi, axis, perm_up)
+            except NameError as e:
+                raise RuntimeError(
+                    "hide_communication must run inside an igg.stencil/shard_map "
+                    "context over the grid mesh (wrap it: "
+                    "igg.stencil(igg.hide_communication(step)))."
+                ) from e
+            if periodic:
+                final_lo, final_hi = recv_lo, recv_hi
+            else:
+                idx = lax.axis_index(axis)
+                fb_lo = patch(plane_of(lo_slab, 0, d), d, 0, received)
+                fb_hi = patch(plane_of(hi_slab, w - 1, d), d, n - 1, received)
+                final_lo = jnp.where(idx > 0, recv_lo, fb_lo)
+                final_hi = jnp.where(idx < nd - 1, recv_hi, fb_hi)
+        A = _halo._set_plane(A, final_lo, 0, d)
+        A = _halo._set_plane(A, final_hi, n - 1, d)
+        received[d] = (final_lo, final_hi)
+    return A

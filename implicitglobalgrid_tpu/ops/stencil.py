@@ -1,0 +1,139 @@
+"""`stencil` — run single-device solver code on every block of the grid.
+
+This is the TPU carrier of the reference's core promise: a solver written for
+one local array becomes a distributed one.  In the reference that works
+because each MPI process executes the same Julia code on its local array; here
+the same effect is `jax.shard_map` over the grid mesh — the decorated function
+is traced once with *local block* arguments and compiled SPMD across the
+slice, and `update_halo` calls inside it inline into the same XLA program
+(fusing communication with compute).
+
+Field arguments (arrays whose per-dimension sizes are divisible by the mesh
+``dims``) are sharded one block per device; anything else is replicated.
+Override with explicit ``in_specs``/``out_specs`` when the heuristic is wrong
+(e.g. a parameter vector whose length happens to be divisible by ``dims[0]``).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any
+
+import numpy as np
+
+from ..parallel import grid as _grid
+from ..parallel.topology import AXIS_NAMES
+
+# Live stencil objects, so finalize_global_grid can evict their compiled
+# executables (each pins the old mesh and program memory).
+_instances: "weakref.WeakSet[_Stencil]" = weakref.WeakSet()
+
+
+def _clear_caches() -> None:
+    for s in list(_instances):
+        s._cache.clear()
+
+
+def _infer_spec(leaf, gg):
+    from jax.sharding import PartitionSpec as P
+
+    ndim = np.ndim(leaf)
+    if ndim == 0:
+        return P()
+    shape = np.shape(leaf)
+    if all(shape[d] % gg.dims[d] == 0 and shape[d] > 0 for d in range(min(ndim, 3))):
+        return P(*AXIS_NAMES[:ndim])
+    return P()
+
+
+def stencil(fn=None, *, in_specs=None, out_specs=None, donate_argnums=()):
+    """Decorate a per-block step function; returns a jit-compiled SPMD callable.
+
+    Example::
+
+        @igg.stencil
+        def step(T, Cp):          # T, Cp are the LOCAL (nx,ny,nz) blocks here
+            ...
+            T = igg.update_halo(T)
+            return T
+
+        T = step(T, Cp)           # called with global-block fields
+    """
+    if fn is None:
+        return lambda f: stencil(
+            f, in_specs=in_specs, out_specs=out_specs, donate_argnums=donate_argnums
+        )
+    return _Stencil(fn, in_specs, out_specs, donate_argnums)
+
+
+class _Stencil:
+    def __init__(self, fn, in_specs, out_specs, donate_argnums):
+        self._fn = fn
+        self._in_specs = in_specs
+        self._out_specs = out_specs
+        self._donate = tuple(donate_argnums) if donate_argnums else ()
+        self._cache: dict[Any, Any] = {}
+        self.__wrapped__ = fn
+        self.__doc__ = fn.__doc__
+        _instances.add(self)
+
+    def __call__(self, *args):
+        import jax
+
+        _grid.check_initialized()
+        gg = _grid.global_grid()
+        leaves, treedef = jax.tree.flatten(args)
+        sig = (
+            gg.epoch,
+            treedef,
+            tuple((np.shape(l), getattr(l, "dtype", type(l))) for l in leaves),
+        )
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            compiled = self._build(gg, args, treedef)
+            self._cache[sig] = compiled
+        return compiled(*args)
+
+    def _build(self, gg, args, treedef):
+        import jax
+
+        if self._in_specs is not None:
+            in_specs = self._in_specs
+        else:
+            in_specs = jax.tree.map(lambda l: _infer_spec(l, gg), args)
+
+        if self._out_specs is not None:
+            out_specs = self._out_specs
+        else:
+            # Infer output specs with a probe trace: out_specs=P() preserves
+            # every output's rank (replication promise, never executed), and
+            # eval_shape of the shard_map gives the output tree with the axis
+            # environment in place (so collectives inside `fn` trace fine).
+            from jax.sharding import PartitionSpec as P
+
+            probe = jax.shard_map(
+                self._fn,
+                mesh=gg.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=P(),
+                check_vma=False,
+            )
+            out_shape = jax.eval_shape(probe, *args)
+            out_specs = jax.tree.map(
+                lambda l: _infer_spec_from_ndim(len(l.shape)), out_shape
+            )
+
+        mapped = jax.shard_map(
+            self._fn,
+            mesh=gg.mesh,
+            in_specs=tuple(in_specs),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=self._donate)
+
+
+def _infer_spec_from_ndim(ndim: int):
+    from jax.sharding import PartitionSpec as P
+
+    return P(*AXIS_NAMES[:ndim])

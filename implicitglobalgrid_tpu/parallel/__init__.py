@@ -1,0 +1,1 @@
+"""Topology, grid state and distributed runtime."""

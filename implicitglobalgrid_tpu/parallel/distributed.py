@@ -1,0 +1,77 @@
+"""Multi-host runtime helpers (the reference's "MPI layer", §2.3 of SURVEY.md).
+
+The reference leans on `MPI.Init`/communicators for its process runtime
+(`/root/reference/src/init_global_grid.jl:78-92`).  JAX's multi-controller
+runtime plays that role on TPU pods: one Python process per host, all devices
+visible as one mesh, collectives compiled to ICI/DCN transfers.  These are
+thin, explicit wrappers so applications keep the reference's
+init-before-grid / finalize-after-grid lifecycle.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> None:
+    """Initialize the JAX distributed runtime (multi-host).
+
+    The analogue of `MPI.Init()` in `init_global_grid`
+    (`/root/reference/src/init_global_grid.jl:78-83`).  On Cloud TPU pods the
+    arguments are auto-detected and may all be ``None``.  Safe to call when
+    already initialized (no-op), mirroring the reference's `init_MPI=false`
+    escape hatch.
+    """
+    import jax
+
+    if is_distributed_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def is_distributed_initialized() -> bool:
+    import jax
+
+    state = getattr(jax._src.distributed, "global_state", None)
+    return bool(state is not None and state.client is not None)
+
+
+def shutdown_distributed() -> None:
+    """Shut down the distributed runtime (`MPI.Finalize` analogue,
+    `/root/reference/src/finalize_global_grid.jl:19-23`)."""
+    import jax
+
+    if is_distributed_initialized():
+        jax.distributed.shutdown()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def sync_all_processes() -> None:
+    """Host-level barrier across all processes (and their devices)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("igg_sync")

@@ -1,0 +1,146 @@
+"""Cartesian process/device topology for the implicit global grid.
+
+TPU-native replacement for the reference's MPI topology layer
+(`/root/reference/src/init_global_grid.jl:84-92`): instead of
+``MPI_Dims_create`` + ``MPI_Cart_create`` + ``MPI_Cart_shift`` we factor the
+device count into a 1/2/3-D grid and build a `jax.sharding.Mesh` over the TPU
+slice.  With ``reorder=1`` (the analogue of ``MPI_Cart_create``'s reorder
+flag) the device order is chosen by ``mesh_utils.create_device_mesh`` so mesh
+axes ride the physical ICI torus; with ``reorder=0`` devices are laid out in
+row-major rank order.
+
+Rank convention: like an MPI Cartesian communicator created in C order, the
+rank of the block at Cartesian coordinates ``(cx, cy, cz)`` is
+``(cx * dims[1] + cy) * dims[2] + cz`` (dimension 0 varies slowest).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+PROC_NULL = -1  # analogue of MPI.PROC_NULL (reference: src/shared.jl neighbors init)
+NDIMS = 3  # fixed internal dimensionality (reference: src/shared.jl:29 NDIMS_MPI = 3)
+NNEIGHBORS_PER_DIM = 2  # left + right (reference: src/shared.jl:30)
+
+AXIS_NAMES = ("x", "y", "z")  # mesh axis names used by all collectives
+
+
+def _prime_factors(n: int) -> list[int]:
+    fs = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            fs.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        fs.append(n)
+    return fs
+
+
+def dims_create(nprocs: int, dims: tuple[int, int, int]) -> tuple[int, int, int]:
+    """Factor ``nprocs`` into a balanced Cartesian grid.
+
+    Semantics of ``MPI_Dims_create`` (used by the reference at
+    `/root/reference/src/init_global_grid.jl:85`): entries of ``dims`` that are
+    nonzero are kept fixed; zero entries are filled with a factorization of
+    ``nprocs / prod(fixed)`` that is as balanced as possible, with larger
+    factors placed in lower (earlier) free dimensions.
+    """
+    dims = tuple(int(d) for d in dims)
+    if any(d < 0 for d in dims):
+        raise ValueError(f"dims entries must be >= 0, got {dims}")
+    fixed_prod = 1
+    for d in dims:
+        if d > 0:
+            fixed_prod *= d
+    if nprocs % fixed_prod != 0:
+        raise ValueError(
+            f"The number of devices ({nprocs}) is not divisible by the product of "
+            f"the fixed dims entries ({fixed_prod})."
+        )
+    free = [i for i, d in enumerate(dims) if d == 0]
+    rem = nprocs // fixed_prod
+    if not free:
+        if fixed_prod != nprocs:
+            raise ValueError(
+                f"prod(dims)={fixed_prod} does not match the number of devices ({nprocs})."
+            )
+        return dims
+    # Distribute prime factors of `rem` over the free slots as evenly as possible:
+    # repeatedly multiply the currently-smallest slot by the largest remaining factor.
+    slots = [1] * len(free)
+    for f in sorted(_prime_factors(rem), reverse=True):
+        slots[int(np.argmin(slots))] *= f
+    # MPI_Dims_create returns free dims in non-increasing order.
+    slots.sort(reverse=True)
+    out = list(dims)
+    for i, s in zip(free, slots):
+        out[i] = s
+    return tuple(out)
+
+
+def rank_of_coords(coords, dims) -> int:
+    """Row-major (C-order) rank of Cartesian coordinates, dim 0 slowest."""
+    cx, cy, cz = coords
+    return (cx * dims[1] + cy) * dims[2] + cz
+
+
+def coords_of_rank(rank: int, dims) -> tuple[int, int, int]:
+    cz = rank % dims[2]
+    cy = (rank // dims[2]) % dims[1]
+    cx = rank // (dims[1] * dims[2])
+    return (cx, cy, cz)
+
+
+def neighbors_table(coords, dims, periods, disp: int = 1) -> np.ndarray:
+    """Neighbor ranks, shape (NNEIGHBORS_PER_DIM, NDIMS).
+
+    ``neighbors[0, d]`` is the lower/left neighbor in dimension ``d`` (the
+    source of an ``MPI_Cart_shift(d, disp)``), ``neighbors[1, d]`` the
+    upper/right one (the destination); ``PROC_NULL`` (-1) where the grid is
+    non-periodic and the shift falls off the edge.  Mirrors the table built at
+    `/root/reference/src/init_global_grid.jl:89-92`.
+    """
+    nbrs = np.full((NNEIGHBORS_PER_DIM, NDIMS), PROC_NULL, dtype=np.int32)
+    for d in range(NDIMS):
+        for sgn, n in ((-1, 0), (+1, 1)):
+            c = list(coords)
+            c[d] += sgn * disp
+            if periods[d]:
+                c[d] %= dims[d]
+            elif not (0 <= c[d] < dims[d]):
+                continue
+            nbrs[n, d] = rank_of_coords(c, dims)
+    return nbrs
+
+
+def create_mesh(dims, devices=None, reorder: int = 1):
+    """Build the 3-D device mesh with axis names ("x", "y", "z").
+
+    ``reorder=1`` lets JAX pick a device order that maps mesh axes onto the
+    physical ICI torus (`mesh_utils.create_device_mesh`) — the analogue of
+    ``MPI_Cart_create(..., reorder=1)`` at
+    `/root/reference/src/init_global_grid.jl:86`.  ``reorder=0`` keeps plain
+    rank order (row-major over the Cartesian coordinates).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    dims = tuple(int(d) for d in dims)
+    n = int(np.prod(dims))
+    if n != len(devices):
+        raise ValueError(
+            f"prod(dims)={n} does not match the number of devices ({len(devices)})."
+        )
+    if reorder and len(devices) > 1:
+        try:
+            dev_array = mesh_utils.create_device_mesh(dims, devices=devices)
+        except Exception:  # fall back to rank order (e.g. heterogeneous CPU meshes)
+            dev_array = np.asarray(devices).reshape(dims)
+    else:
+        dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, AXIS_NAMES)

@@ -1,0 +1,1 @@
+"""Index math, field constructors and configuration."""

@@ -1,0 +1,139 @@
+"""Global-grid index math (reference: `/root/reference/src/tools.jl`).
+
+The "implicit" in implicit global grid: global sizes and physical coordinates
+are *computed* from (local size, dims, coords, overlap, period) — the global
+array never exists.  The formulas are ported bit-exact from the reference
+(`src/tools.jl:24-59` for sizes, `:98-107/:146-155/:194-203` for coordinates),
+with one deliberate API change: element indices are **0-based** (Python)
+where the reference is 1-based, i.e. ``x_g(i, dx, A)`` here equals the
+reference's ``x_g(i+1, dx, A)``.
+
+Coordinate helpers work in two contexts:
+
+* On the host (e.g. in tests or per-process logic): coordinates default to the
+  grid singleton's ``coords``.
+* Inside `igg.stencil`/`shard_map` (tracing): the block coordinates come from
+  `lax.axis_index`, so one formula serves every block of the mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel import grid as _grid
+from ..parallel.topology import AXIS_NAMES
+
+
+def _local_size(A, dim: int, gg) -> int:
+    """Local (per-block) size of ``A`` in ``dim``.
+
+    Index math accepts both field representations: global-block `jax.Array`s
+    (shape ``dims*local``) and plain host arrays in the reference's local view
+    (shape as-is) — the latter distinguished by not dividing evenly.
+    """
+    from ..ops.halo import local_shape
+
+    try:
+        shp = local_shape(A, gg)
+    except ValueError:
+        shp = tuple(np.shape(A))
+    return shp[dim] if dim < len(shp) else 1
+
+
+def nx_g(A=None):
+    """Global grid size in x; with ``A``, the global size of array ``A``
+    (staggering-aware: ``nx_g + (size(A,0) - nx)``, reference src/tools.jl:45)."""
+    gg = _grid.global_grid()
+    if A is None:
+        return gg.nxyz_g[0]
+    return gg.nxyz_g[0] + (_local_size(A, 0, gg) - gg.nxyz[0])
+
+
+def ny_g(A=None):
+    gg = _grid.global_grid()
+    if A is None:
+        return gg.nxyz_g[1]
+    return gg.nxyz_g[1] + (_local_size(A, 1, gg) - gg.nxyz[1])
+
+
+def nz_g(A=None):
+    gg = _grid.global_grid()
+    if A is None:
+        return gg.nxyz_g[2]
+    return gg.nxyz_g[2] + (_local_size(A, 2, gg) - gg.nxyz[2])
+
+
+ny_g.__doc__ = nx_g.__doc__.replace(" x;", " y;") if nx_g.__doc__ else None
+nz_g.__doc__ = nx_g.__doc__.replace(" x;", " z;") if nx_g.__doc__ else None
+
+
+def _coord(dim: int, gg, coords):
+    """Block coordinate in ``dim``: explicit > traced axis_index > grid.coords."""
+    if coords is not None:
+        return coords[dim]
+    if gg.dims[dim] > 1:
+        # Inside an igg.stencil/shard_map trace the block coordinate comes
+        # from the mesh; on the host (no axis environment) fall back to this
+        # process's coords, matching the reference's per-rank view.
+        from jax import lax
+
+        try:
+            return lax.axis_index(AXIS_NAMES[dim])
+        except Exception:
+            pass
+    return gg.coords[dim]
+
+
+def _coord_g(i, d, A, dim: int, coords):
+    """Shared implementation of x_g/y_g/z_g (reference formula, src/tools.jl:98-107)."""
+    import jax
+
+    gg = _grid.global_grid()
+    n = gg.nxyz[dim]
+    o = gg.overlaps[dim]
+    n_g = gg.nxyz_g[dim]
+    size_d = _local_size(A, dim, gg) if A is not None else n
+    c = _coord(dim, gg, coords)
+
+    traced = isinstance(c, jax.core.Tracer) or isinstance(i, jax.core.Tracer)
+    if traced:
+        import jax.numpy as jnp
+
+        xp = jnp
+    else:
+        xp = np
+    i = xp.asarray(i)
+    x0 = 0.5 * (n - size_d) * d
+    x = (c * (n - o) + i) * d + x0
+    if gg.periods[dim]:
+        # The first cell of the periodic global problem is a ghost cell: shift
+        # by one spacing and wrap (reference: src/tools.jl:101-105).
+        x = x - d
+        x = xp.where(x > (n_g - 1) * d, x - n_g * d, x)
+        x = xp.where(x < 0, x + n_g * d, x)
+    if not traced and x.ndim == 0:
+        return float(x)
+    return x
+
+
+def x_g(ix, dx, A=None, *, coords=None):
+    """Global x-coordinate of local element ``ix`` (0-based) of array ``A``.
+
+    ``dx`` is the grid spacing.  ``ix`` may be a scalar or an index array.
+    Staggered arrays (e.g. size ``nx+1``) are offset by ``0.5*(nx-size)*dx``
+    exactly like the reference (`/root/reference/src/tools.jl:98-107`).
+    ``coords`` overrides the block coordinates (useful for computing another
+    block's coordinates on the host); inside `igg.stencil` the block
+    coordinate is taken from the mesh automatically.
+    """
+    return _coord_g(ix, dx, A, 0, coords)
+
+
+def y_g(iy, dy, A=None, *, coords=None):
+    """Global y-coordinate of local element ``iy`` (0-based) of array ``A``."""
+    return _coord_g(iy, dy, A, 1, coords)
+
+
+def z_g(iz, dz, A=None, *, coords=None):
+    """Global z-coordinate of local element ``iz`` (0-based) of array ``A``."""
+    return _coord_g(iz, dz, A, 2, coords)

@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4).
+
+The reference runs each test file in a fresh process because MPI can only be
+initialized once (`/root/reference/test/runtests.jl:24`); here the grid is
+re-initializable, so ordinary pytest works.  Multi-device coverage without
+hardware comes from 8 virtual CPU devices — the TPU translation of the
+reference's single-process self-neighbor trick plus real multi-rank runs.
+"""
+
+import os
+
+import pytest
+
+# The axon sitecustomize may already have imported jax and registered the TPU
+# plugin, so env vars are too late — use jax.config, which works post-import.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)  # reference tests are Float64-heavy
+
+
+@pytest.fixture(autouse=True)
+def _finalize_grid_after_test():
+    yield
+    import implicitglobalgrid_tpu as igg
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()

@@ -1,0 +1,87 @@
+"""Tests for gather (ported from `/root/reference/test/test_gather.jl`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+
+
+def test_gather_roundtrip_block_layout():
+    me, dims, nprocs, *_ = igg.init_global_grid(4, 4, 4, quiet=True)
+    # fill each block with its rank → gathered array must be block-constant
+    def fill(coords):
+        cx, cy, cz = coords
+        r = (cx * dims[1] + cy) * dims[2] + cz
+        return jnp.full((4, 4, 4), r, jnp.float32)
+
+    A = igg.from_block_fn(fill, (4, 4, 4), jnp.float32)
+    g = igg.gather(A)
+    assert g.shape == tuple(d * 4 for d in dims)
+    for cx in range(dims[0]):
+        for cy in range(dims[1]):
+            for cz in range(dims[2]):
+                blk = g[cx * 4:(cx + 1) * 4, cy * 4:(cy + 1) * 4, cz * 4:(cz + 1) * 4]
+                assert (blk == (cx * dims[1] + cy) * dims[2] + cz).all()
+
+
+@pytest.mark.parametrize("dtype", ["float16", "float32", "float64", "int16", "complex64"])
+def test_gather_dtypes(dtype):
+    # reference dtype matrix: test_gather.jl:98-125
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    A = igg.full((4, 4, 4), 3, dtype)
+    g = igg.gather(A)
+    assert g.dtype == np.dtype(dtype)
+    assert (g == 3).all()
+
+
+def test_gather_into_out_array():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    gg = igg.get_global_grid()
+    A = igg.ones((4, 4, 4), "float64")
+    out = np.zeros(tuple(d * 4 for d in gg.dims))
+    ret = igg.gather(A, out)
+    assert ret is None
+    assert (out == 1).all()
+
+
+def test_gather_size_mismatch_error():
+    # reference: test_gather.jl:19-34
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    A = igg.ones((4, 4, 4), "float64")
+    with pytest.raises(ValueError, match="nprocs"):
+        igg.gather(A, np.zeros((4, 4, 4)))
+
+
+def test_gather_dtype_mismatch_error():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    gg = igg.get_global_grid()
+    A = igg.ones((4, 4, 4), "float32")
+    with pytest.raises(ValueError, match="dtype"):
+        igg.gather(A, np.zeros(tuple(d * 4 for d in gg.dims), np.float64))
+
+
+def test_gather_1d_2d():
+    igg.init_global_grid(4, 4, 1, quiet=True)
+    gg = igg.get_global_grid()
+    A = igg.full((4, 4), 7, "float32")
+    g = igg.gather(A)
+    assert g.shape == (gg.dims[0] * 4, gg.dims[1] * 4)
+    assert (g == 7).all()
+
+
+def test_gather_after_block_slice():
+    # the reference idiom: strip the halo locally, then gather
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    dims = igg.get_global_grid().dims
+    A = igg.from_block_fn(
+        lambda c: jnp.arange(64, dtype=jnp.float32).reshape(4, 4, 4), (4, 4, 4)
+    )
+    inner = igg.block_slice(A, (slice(1, -1),) * 3)
+    g = igg.gather(inner)
+    assert g.shape == tuple(d * 2 for d in dims)
+    expect = np.arange(64, dtype=np.float32).reshape(4, 4, 4)[1:-1, 1:-1, 1:-1]
+    for cx in range(dims[0]):
+        blk = g[cx * 2:(cx + 1) * 2, 0:2, 0:2]
+        np.testing.assert_array_equal(blk, expect)

@@ -1,0 +1,132 @@
+"""Tests for init_global_grid / finalize_global_grid / topology.
+
+Ported from `/root/reference/test/test_init_global_grid.jl` (error cases,
+implicit global size, neighbor table) plus TPU-specific mesh assertions.
+"""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.parallel import topology
+
+
+def test_basic_init_returns():
+    me, dims, nprocs, coords, mesh = igg.init_global_grid(4, 4, 4, quiet=True)
+    assert nprocs == 8
+    assert int(np.prod(dims)) == 8
+    assert me == 0
+    assert coords == topology.coords_of_rank(0, dims)
+    assert mesh.axis_names == ("x", "y", "z")
+    assert tuple(mesh.devices.shape) == tuple(dims)
+    gg = igg.get_global_grid()
+    assert gg.nxyz == (4, 4, 4)
+    # nxyz_g = dims*(nxyz-overlaps) + overlaps*(periods==0)  (init_global_grid.jl:93)
+    assert gg.nxyz_g == tuple(d * (4 - 2) + 2 for d in dims)
+
+
+def test_double_init_error():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    with pytest.raises(RuntimeError, match="already been initialized"):
+        igg.init_global_grid(4, 4, 4, quiet=True)
+
+
+def test_not_initialized_error():
+    with pytest.raises(RuntimeError, match="before init_global_grid"):
+        igg.nx_g()
+    with pytest.raises(RuntimeError, match="before init_global_grid"):
+        igg.finalize_global_grid()
+
+
+def test_invalid_args():
+    # /root/reference/test/test_init_global_grid.jl:92-110 error matrix
+    with pytest.raises(ValueError, match="nx can never be 1"):
+        igg.init_global_grid(1, 4, 4, quiet=True)
+    with pytest.raises(ValueError, match="ny cannot be 1 if nz"):
+        igg.init_global_grid(4, 1, 4, quiet=True)
+    with pytest.raises(ValueError, match="must not be set"):
+        igg.init_global_grid(4, 1, 1, dimy=2, quiet=True)
+    with pytest.raises(ValueError, match="period"):
+        igg.init_global_grid(4, 2, 1, periody=1, dimy=1, quiet=True)  # ny < 2*ol-1
+    with pytest.raises(ValueError, match="device_type"):
+        igg.init_global_grid(4, 4, 4, device_type="rocm", quiet=True)
+    assert not igg.grid_is_initialized()
+
+
+def test_periodic_global_size():
+    me, dims, *_ = igg.init_global_grid(5, 5, 5, periodx=1, periody=1, periodz=1, quiet=True)
+    # periodic: no +overlap correction
+    assert igg.nx_g() == dims[0] * 3
+    assert igg.ny_g() == dims[1] * 3
+    assert igg.nz_g() == dims[2] * 3
+
+
+def test_fixed_dims_and_overlap():
+    me, dims, nprocs, coords, mesh = igg.init_global_grid(
+        8, 8, 8, dimx=4, dimy=2, dimz=1, overlapx=3, quiet=True
+    )
+    assert dims == (4, 2, 1)
+    assert igg.nx_g() == 4 * (8 - 3) + 3
+    assert igg.ny_g() == 2 * (8 - 2) + 2
+    assert igg.nz_g() == 1 * (8 - 2) + 2
+
+
+def test_dims_create():
+    assert topology.dims_create(8, (0, 0, 0)) == (2, 2, 2)
+    assert topology.dims_create(12, (0, 0, 0)) == (3, 2, 2)
+    assert topology.dims_create(6, (0, 3, 0)) == (2, 3, 1)
+    assert topology.dims_create(8, (8, 0, 0)) == (8, 1, 1)
+    assert topology.dims_create(7, (0, 0, 0)) == (7, 1, 1)
+    assert topology.dims_create(16, (0, 0, 0)) == (4, 2, 2)
+    with pytest.raises(ValueError):
+        topology.dims_create(8, (3, 0, 0))
+
+
+def test_neighbors_table():
+    dims, periods = (2, 2, 2), (0, 0, 1)
+    nb = topology.neighbors_table((0, 0, 0), dims, periods)
+    # rank of (cx,cy,cz) = (cx*2+cy)*2+cz
+    assert nb[0, 0] == igg.PROC_NULL and nb[1, 0] == 4  # x: no lower, upper=(1,0,0)
+    assert nb[0, 1] == igg.PROC_NULL and nb[1, 1] == 2  # y
+    assert nb[0, 2] == 1 and nb[1, 2] == 1  # z periodic with dims 2: both sides = (0,0,1)
+    nb = topology.neighbors_table((1, 1, 1), dims, periods)
+    assert nb[1, 0] == igg.PROC_NULL and nb[0, 0] == 3
+    # self-neighbor when dims==1 and periodic
+    nb = topology.neighbors_table((0, 0, 0), (1, 1, 1), (1, 0, 0))
+    assert nb[0, 0] == 0 and nb[1, 0] == 0
+    assert nb[0, 1] == igg.PROC_NULL
+
+
+def test_rank_coords_roundtrip():
+    dims = (2, 2, 2)
+    for r in range(8):
+        assert topology.rank_of_coords(topology.coords_of_rank(r, dims), dims) == r
+
+
+def test_1d_and_2d_grids():
+    me, dims, nprocs, *_ = igg.init_global_grid(4, 1, 1, quiet=True)
+    assert dims == (8, 1, 1)
+    assert igg.nx_g() == 8 * 2 + 2 and igg.ny_g() == 1 and igg.nz_g() == 1
+    igg.finalize_global_grid()
+    me, dims, *_ = igg.init_global_grid(4, 4, 1, quiet=True)
+    assert dims[2] == 1 and int(np.prod(dims)) == 8
+
+
+def test_select_device():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    dev = igg.select_device()
+    assert dev.platform == "cpu"
+
+
+def test_finalize_then_reinit():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.finalize_global_grid()
+    assert not igg.grid_is_initialized()
+    igg.init_global_grid(5, 5, 5, quiet=True)
+    assert igg.get_global_grid().nxyz == (5, 5, 5)
+
+
+def test_tic_toc():
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    igg.tic()
+    assert igg.toc() >= 0.0

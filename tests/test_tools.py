@@ -1,0 +1,123 @@
+"""Tests for the global index math (nx_g/x_g & co).
+
+Ported from `/root/reference/test/test_tools.jl`, including the simulated
+3x3x3-topology testset (`:116-166`) with its exact pinned values — indices
+translated from the reference's 1-based to this API's 0-based convention
+(``x_g(i) == reference x_g(i+1)``).
+"""
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+
+
+def _sim_grid(nx, ny, nz, dims, periods=(0, 0, 0), **kw):
+    """Init a 1x1x1 grid then fake a larger topology (the reference's
+    simulated-topology trick, test_tools.jl:125-133, enabled here by
+    GlobalGrid.replace instead of in-place array mutation)."""
+    igg.init_global_grid(nx, ny, nz, dimx=1, dimy=1, dimz=1, quiet=True,
+                         devices=[__import__("jax").devices()[0]], **kw)
+    gg = igg.get_global_grid()
+    nxyz_g = tuple(
+        d * (n - o) + o * (p == 0)
+        for n, d, o, p in zip(gg.nxyz, dims, gg.overlaps, gg.periods)
+    )
+    igg.set_global_grid(gg.replace(dims=tuple(dims), nxyz_g=nxyz_g, nprocs=int(np.prod(dims))))
+    return igg.get_global_grid()
+
+
+def test_nxg_staggered_single():
+    # reference test_tools.jl testset 1: nx=5,ny=5,nz=5 single proc
+    igg.init_global_grid(5, 5, 5, quiet=True, devices=[__import__("jax").devices()[0]])
+    A = np.zeros((5, 5, 5))
+    Vx = np.zeros((6, 5, 5))
+    Sxz = np.zeros((4, 3, 6))
+    assert igg.nx_g() == 5 and igg.ny_g() == 5 and igg.nz_g() == 5
+    assert igg.nx_g(A) == 5
+    assert igg.nx_g(Vx) == 6 and igg.ny_g(Vx) == 5
+    assert igg.nx_g(Sxz) == 4 and igg.ny_g(Sxz) == 3 and igg.nz_g(Sxz) == 6
+
+
+def test_xg_single_proc():
+    # reference doctest (src/tools.jl:66-96): lx=4, nx=3 → dx=2; A(3): [0,2,4]; Vx(4): [-1,1,3,5]
+    igg.init_global_grid(3, 3, 3, quiet=True, devices=[__import__("jax").devices()[0]])
+    lx = 4
+    dx = lx / (igg.nx_g() - 1)
+    A = np.zeros((3, 3, 3))
+    Vx = np.zeros((4, 3, 3))
+    assert [igg.x_g(i, dx, A) for i in range(3)] == [0.0, 2.0, 4.0]
+    assert [igg.x_g(i, dx, Vx) for i in range(4)] == [-1.0, 1.0, 3.0, 5.0]
+    assert [igg.y_g(i, dx, A) for i in range(3)] == [0.0, 2.0, 4.0]
+    assert [igg.z_g(i, dx, A) for i in range(3)] == [0.0, 2.0, 4.0]
+
+
+def test_xg_simulated_3x3x3():
+    # reference test_tools.jl:116-166, exact pinned values (0-based here).
+    lx, ly, lz = 20, 20, 16
+    nx = ny = nz = 5
+    _sim_grid(nx, ny, nz, (3, 3, 3), periodz=1)
+    P = np.zeros((nx, ny, nz))
+    A = np.zeros((nx + 1, ny - 2, nz + 2))
+    assert igg.nx_g() == 3 * 3 + 2 == 11
+    assert igg.nz_g() == 3 * 3 == 9  # periodic: no overlap correction
+    dx = lx / (igg.nx_g() - 1)
+    dy = ly / (igg.ny_g() - 1)
+    dz = lz / (igg.nz_g() - 1)
+
+    def xs(f, n, d, arr, c):
+        return [f(i, d, arr, coords=c) for i in range(n)]
+
+    assert xs(igg.x_g, 5, dx, P, (0, 0, 0)) == [0.0, 2.0, 4.0, 6.0, 8.0]
+    assert xs(igg.x_g, 5, dx, P, (1, 0, 0)) == [6.0, 8.0, 10.0, 12.0, 14.0]
+    assert xs(igg.x_g, 5, dx, P, (2, 0, 0)) == [12.0, 14.0, 16.0, 18.0, 20.0]
+    assert xs(igg.y_g, 5, dy, P, (0, 0, 0)) == [0.0, 2.0, 4.0, 6.0, 8.0]
+    assert xs(igg.y_g, 5, dy, P, (0, 1, 0)) == [6.0, 8.0, 10.0, 12.0, 14.0]
+    assert xs(igg.y_g, 5, dy, P, (0, 2, 0)) == [12.0, 14.0, 16.0, 18.0, 20.0]
+    assert xs(igg.z_g, 5, dz, P, (0, 0, 0)) == [16.0, 0.0, 2.0, 4.0, 6.0]
+    assert xs(igg.z_g, 5, dz, P, (0, 0, 1)) == [4.0, 6.0, 8.0, 10.0, 12.0]
+    assert xs(igg.z_g, 5, dz, P, (0, 0, 2)) == [10.0, 12.0, 14.0, 16.0, 0.0]
+    assert xs(igg.x_g, 6, dx, A, (0, 0, 0)) == [-1.0, 1.0, 3.0, 5.0, 7.0, 9.0]
+    assert xs(igg.x_g, 6, dx, A, (1, 0, 0)) == [5.0, 7.0, 9.0, 11.0, 13.0, 15.0]
+    assert xs(igg.x_g, 6, dx, A, (2, 0, 0)) == [11.0, 13.0, 15.0, 17.0, 19.0, 21.0]
+    assert xs(igg.y_g, 3, dy, A, (0, 0, 0)) == [2.0, 4.0, 6.0]
+    assert xs(igg.y_g, 3, dy, A, (0, 1, 0)) == [8.0, 10.0, 12.0]
+    assert xs(igg.y_g, 3, dy, A, (0, 2, 0)) == [14.0, 16.0, 18.0]
+    assert xs(igg.z_g, 7, dz, A, (0, 0, 0)) == [14.0, 16.0, 0.0, 2.0, 4.0, 6.0, 8.0]
+    assert xs(igg.z_g, 7, dz, A, (0, 0, 1)) == [2.0, 4.0, 6.0, 8.0, 10.0, 12.0, 14.0]
+    assert xs(igg.z_g, 7, dz, A, (0, 0, 2)) == [8.0, 10.0, 12.0, 14.0, 16.0, 0.0, 2.0]
+
+
+def test_xg_vectorized():
+    igg.init_global_grid(5, 5, 5, quiet=True, devices=[__import__("jax").devices()[0]])
+    A = np.zeros((5, 5, 5))
+    vec = igg.x_g(np.arange(5), 2.0, A)
+    assert np.array_equal(vec, [0.0, 2.0, 4.0, 6.0, 8.0])
+
+
+def test_coord_fields_match_xg():
+    me, dims, *_ = igg.init_global_grid(4, 4, 4, periodz=1, quiet=True)
+    dx = dy = dz = 1.5
+    T = igg.zeros((4, 4, 4), "float64")
+    XG, YG, ZG = igg.coord_fields(T, (dx, dy, dz))
+    xg = np.asarray(XG)
+    yg = np.asarray(YG)
+    zg = np.asarray(ZG)
+    D = dims
+    for cx in range(D[0]):
+        for cy in range(D[1]):
+            for cz in range(D[2]):
+                blk = np.s_[cx * 4:(cx + 1) * 4, cy * 4:(cy + 1) * 4, cz * 4:(cz + 1) * 4]
+                ex = np.asarray([igg.x_g(i, dx, T, coords=(cx, cy, cz)) for i in range(4)])
+                ey = np.asarray([igg.y_g(i, dy, T, coords=(cx, cy, cz)) for i in range(4)])
+                ez = np.asarray([igg.z_g(i, dz, T, coords=(cx, cy, cz)) for i in range(4)])
+                np.testing.assert_allclose(xg[blk], ex[:, None, None] * np.ones((4, 4, 4)))
+                np.testing.assert_allclose(yg[blk], ey[None, :, None] * np.ones((4, 4, 4)))
+                np.testing.assert_allclose(zg[blk], ez[None, None, :] * np.ones((4, 4, 4)), atol=1e-12)
+
+
+def test_nxg_staggered_multidevice():
+    me, dims, *_ = igg.init_global_grid(4, 4, 4, quiet=True)
+    Vx = igg.zeros((5, 4, 4))
+    assert igg.nx_g(Vx) == igg.nx_g() + 1
+    assert igg.ny_g(Vx) == igg.ny_g()

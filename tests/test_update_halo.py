@@ -1,0 +1,333 @@
+"""Tests for update_halo — the core halo-exchange engine.
+
+Strategy (SURVEY.md §4): a numpy simulator mirrors the reference's exchange
+semantics exactly (one plane per side, pack-all-then-unpack per dimension,
+dimensions strictly sequential, shape-aware overlap, PROC_NULL edges keep
+their values — `/root/reference/src/update_halo.jl:40-78,544-563`) and every
+configuration is checked against it with coordinate-encoded unique values.
+Plus: the reference's periodic full-restoration oracle
+(`test_update_halo.jl:746-790`), error paths (`:61-78`), the dtype matrix
+(`:109-177`), and compiled-HLO collective counts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+
+
+# ---------------------------------------------------------------- simulator
+
+
+def blocks_of(arr, dims, lshape):
+    """Split a global-block array into a dict {(cx,cy,cz): local block}."""
+    nd = arr.ndim
+    out = {}
+    D = list(dims[:nd]) + [1] * (3 - nd)
+    for cx in range(D[0]):
+        for cy in range(D[1]):
+            for cz in range(D[2]):
+                ix = tuple(
+                    slice(c * s, (c + 1) * s)
+                    for c, s in zip((cx, cy, cz)[:nd], lshape[:nd])
+                )
+                out[(cx, cy, cz)] = np.array(arr[ix])
+    return out
+
+
+def unblocks(blocks, dims, lshape, nd, dtype):
+    D = list(dims[:nd]) + [1] * (3 - nd)
+    g = np.zeros(tuple(dims[d] * lshape[d] for d in range(nd)), dtype)
+    for (cx, cy, cz), b in blocks.items():
+        ix = tuple(
+            slice(c * s, (c + 1) * s) for c, s in zip((cx, cy, cz)[:nd], lshape[:nd])
+        )
+        g[ix] = b
+    return g
+
+
+def simulate_update_halo(global_np, gg):
+    """Numpy re-implementation of the reference exchange for one field."""
+    nd = global_np.ndim
+    lshape = tuple(s // gg.dims[d] for d, s in enumerate(global_np.shape))
+    blocks = blocks_of(global_np, gg.dims, lshape)
+    for d in range(3):
+        if d >= nd:
+            continue
+        o = gg.overlaps[d] + (lshape[d] - gg.nxyz[d])
+        if o < 2:
+            continue
+        n = lshape[d]
+        D = gg.dims[d]
+        per = bool(gg.periods[d])
+        if D == 1 and not per:
+            continue
+        # pack all sends from the pre-exchange state of this dim
+        sends = {}
+        for c, b in blocks.items():
+            sl_lo = [slice(None)] * nd
+            sl_hi = [slice(None)] * nd
+            sl_lo[d] = slice(o - 1, o)
+            sl_hi[d] = slice(n - o, n - o + 1)
+            sends[c] = (b[tuple(sl_lo)].copy(), b[tuple(sl_hi)].copy())
+        # unpack
+        for c, b in blocks.items():
+            ci = list(c)
+            # receive into hi plane (n-1) from upper neighbor's lo send
+            ci[d] = c[d] + 1
+            if ci[d] >= D:
+                ci[d] = 0 if per else None
+            if ci[d] is not None:
+                sl = [slice(None)] * nd
+                sl[d] = slice(n - 1, n)
+                b[tuple(sl)] = sends[tuple(ci)][0]
+            # receive into lo plane (0) from lower neighbor's hi send
+            ci = list(c)
+            ci[d] = c[d] - 1
+            if ci[d] < 0:
+                ci[d] = D - 1 if per else None
+            if ci[d] is not None:
+                sl = [slice(None)] * nd
+                sl[d] = slice(0, 1)
+                b[tuple(sl)] = sends[tuple(ci)][1]
+    return unblocks(blocks, gg.dims, lshape, nd, global_np.dtype)
+
+
+def unique_field(lshape, gg, dtype=np.float64):
+    """Globally unique values per element (the coordinate-encoding oracle)."""
+    nd = len(lshape)
+    gshape = tuple(gg.dims[d] * lshape[d] for d in range(nd))
+    n = int(np.prod(gshape))
+    vals = (np.arange(n, dtype=np.float64) + 1.0).reshape(gshape)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return vals.astype(dtype)
+    return vals.astype(dtype)
+
+
+def put(arr_np):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gg = igg.get_global_grid()
+    spec = P(*igg.AXIS_NAMES[: arr_np.ndim])
+    return jax.device_put(jnp.asarray(arr_np), NamedSharding(gg.mesh, spec))
+
+
+def check(config, fields_lshapes, dtype=np.float64, **initkw):
+    nx, ny, nz = config
+    igg.init_global_grid(nx, ny, nz, quiet=True, **initkw)
+    gg = igg.get_global_grid()
+    fields = [unique_field(ls, gg, dtype) for ls in fields_lshapes]
+    # Low-precision dtypes can't hold unique large integers: recode small.
+    if np.dtype(dtype) in (np.dtype(np.float16), np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.dtype(np.float16)):
+        fields = [np.mod(f, 512).astype(dtype) for f in fields]
+    outs = igg.update_halo(*[put(f) for f in fields])
+    if len(fields) == 1:
+        outs = (outs,)
+    for f, o in zip(fields, outs):
+        exp = simulate_update_halo(f, gg)
+        np.testing.assert_array_equal(np.asarray(o).astype(np.float64), exp.astype(np.float64))
+    igg.finalize_global_grid()
+
+
+# ---------------------------------------------------------------- oracle tests
+
+
+def test_3d_nonperiodic():
+    check((6, 6, 6), [(6, 6, 6)])
+
+
+def coord_encoded_field(lshape, gg):
+    """Fill from global coordinates (periodic-consistent: wrapped duplicate
+    cells hold equal values) — the reference's oracle fill pattern
+    (`test_update_halo.jl:746`: z_g*1e2 + y_g*1e1 + x_g)."""
+    nd = len(lshape)
+    D = gg.dims
+    g = np.zeros(tuple(D[d] * lshape[d] for d in range(nd)))
+    radix = 1.0
+    coord_fn = [igg.x_g, igg.y_g, igg.z_g]
+    for c in np.ndindex(*D[:nd]):
+        coords3 = tuple(c) + (0,) * (3 - nd)
+        vecs = []
+        for d in range(nd):
+            A = np.zeros(lshape)
+            vecs.append(
+                np.asarray(
+                    [coord_fn[d](i, 1.0, A, coords=coords3) for i in range(lshape[d])]
+                )
+            )
+        val = np.zeros(lshape)
+        mult = 1.0
+        for d in range(nd):
+            shape1 = [1] * nd
+            shape1[d] = lshape[d]
+            val = val + vecs[d].reshape(shape1) * mult
+            mult *= 1000.0
+        ix = tuple(slice(c[d] * lshape[d], (c[d] + 1) * lshape[d]) for d in range(nd))
+        g[ix] = val
+    return g
+
+
+def test_3d_all_periodic_full_restore():
+    # the reference's headline oracle (test_update_halo.jl:746-790): fill from
+    # global coordinates, zero the boundary planes, update_halo → fully restored
+    igg.init_global_grid(6, 6, 6, periodx=1, periody=1, periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    ref = coord_encoded_field((6, 6, 6), gg)
+    zeroed = ref.copy()
+    D = gg.dims
+    for (cx, cy, cz) in np.ndindex(*D):
+        blk = np.s_[cx * 6:(cx + 1) * 6, cy * 6:(cy + 1) * 6, cz * 6:(cz + 1) * 6]
+        b = zeroed[blk]
+        b[0], b[-1], b[:, 0], b[:, -1], b[:, :, 0], b[:, :, -1] = 0, 0, 0, 0, 0, 0
+    out = np.asarray(igg.update_halo(put(zeroed)))
+    np.testing.assert_array_equal(out, simulate_update_halo(zeroed, gg))
+    np.testing.assert_array_equal(out, ref)  # full restoration
+
+
+def test_3d_mixed_periods():
+    check((6, 5, 7), [(6, 5, 7)], periodz=1)
+    check((6, 5, 7), [(6, 5, 7)], periodx=1)
+
+
+def test_staggered_fields():
+    # Vx(nx+1), Vy(ny+1), Vz(nz+1) + P — reference test_update_halo.jl:828-937
+    check((5, 5, 5), [(5, 5, 5), (6, 5, 5), (5, 6, 5), (5, 5, 6)])
+
+
+def test_staggered_periodic():
+    check((5, 5, 5), [(6, 5, 5), (5, 5, 5)], periodz=1)
+
+
+def test_custom_overlaps():
+    check((8, 8, 8), [(8, 8, 8)], overlapx=3, overlapy=4, overlapz=2)
+
+
+def test_overlap3_periodic():
+    check((8, 8, 8), [(8, 8, 8)], overlapx=3, periodx=1)
+
+
+def test_2d():
+    check((6, 6, 1), [(6, 6)])
+    check((6, 6, 1), [(6, 6)], periody=1)
+
+
+def test_1d():
+    check((6, 1, 1), [(6,)])
+    check((6, 1, 1), [(6,)], periodx=1)
+
+
+def test_2d_field_in_3d_grid():
+    # a 2-D field in a 3-D grid has no z halo (ol(3,A)<2) and must skip dim z
+    check((6, 6, 6), [(6, 6, 6), (6, 6)])
+
+
+def test_self_neighbor_periodic_dim():
+    # dims forced so y has a single block but periodic → local-copy fast path
+    check((6, 6, 6), [(6, 6, 6)], dimy=1, periody=1, dimx=4, dimz=2)
+
+
+def test_multi_field_mixed_dtypes():
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    gg = igg.get_global_grid()
+    a = unique_field((6, 6, 6), gg, np.float32)
+    b = unique_field((6, 6, 6), gg, np.float64)
+    oa, ob = igg.update_halo(put(a), put(b))
+    np.testing.assert_array_equal(np.asarray(oa), simulate_update_halo(a, gg))
+    np.testing.assert_array_equal(np.asarray(ob), simulate_update_halo(b, gg))
+
+
+@pytest.mark.parametrize(
+    "dtype", ["float16", "bfloat16", "float32", "float64", "int32", "complex64"]
+)
+def test_dtypes(dtype):
+    # reference dtype matrix: test_update_halo.jl:109-177,938-952
+    if dtype == "complex64":
+        igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
+        gg = igg.get_global_grid()
+        re = unique_field((6, 6, 6), gg, np.float32)
+        f = (re + 1j * (re + 0.5)).astype(np.complex64)
+        out = np.asarray(igg.update_halo(put(f)))
+        np.testing.assert_array_equal(out, simulate_update_halo(f, gg))
+        igg.finalize_global_grid()
+    else:
+        check((6, 6, 6), [(6, 6, 6)], dtype=np.dtype(dtype) if dtype != "bfloat16" else jnp.bfloat16, periodx=1)
+
+
+def test_idempotent_when_consistent():
+    igg.init_global_grid(6, 6, 6, periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    f = unique_field((6, 6, 6), gg)
+    once = igg.update_halo(put(f))
+    twice = igg.update_halo(igg.update_halo(put(f)))
+    np.testing.assert_array_equal(np.asarray(once), np.asarray(twice))
+
+
+# ---------------------------------------------------------------- tracer path
+
+
+def test_inside_stencil_matches_concrete():
+    igg.init_global_grid(6, 6, 6, periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    f = unique_field((6, 6, 6), gg)
+
+    @igg.stencil
+    def step(a):
+        return igg.update_halo(a)
+
+    out_stencil = np.asarray(step(put(f)))
+    np.testing.assert_array_equal(out_stencil, simulate_update_halo(f, gg))
+
+
+def test_update_halo_under_plain_jit_single_device():
+    igg.init_global_grid(6, 6, 6, periodz=1, quiet=True,
+                         devices=[jax.devices()[0]])
+    gg = igg.get_global_grid()
+    f = unique_field((6, 6, 6), gg)
+    out = np.asarray(jax.jit(lambda a: igg.update_halo(a))(jnp.asarray(f)))
+    np.testing.assert_array_equal(out, simulate_update_halo(f, gg))
+
+
+# ---------------------------------------------------------------- errors
+
+
+def test_no_halo_error():
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    bad = igg.zeros((2, 2, 2))  # ol = 2 + 2-6 < 2 in all dims
+    with pytest.raises(ValueError, match="has no halo"):
+        igg.update_halo(bad)
+
+
+def test_duplicate_error():
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    a = igg.zeros((6, 6, 6))
+    with pytest.raises(ValueError, match="duplicate"):
+        igg.update_halo(a, a)
+
+
+def test_indivisible_shape_error():
+    igg.init_global_grid(6, 6, 6, quiet=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        igg.update_halo(np.zeros((7, 13, 6)))
+
+
+# ---------------------------------------------------------------- HLO checks
+
+
+def test_collective_permute_count():
+    # 2 ppermutes per exchanged dim per field; none for self/absent neighbors
+    igg.init_global_grid(6, 6, 6, periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    from implicitglobalgrid_tpu.ops import halo as H
+
+    exchanged_dims = sum(1 for d in range(3) if gg.dims[d] > 1 or gg.periods[d])
+    nfields = 2
+    sig = tuple((((6, 6, 6)), "float64") for _ in range(nfields))
+    fn = H._global_update_fn(gg, sig)
+    f = unique_field((6, 6, 6), gg)
+    g = unique_field((6, 6, 6), gg) * 2
+    hlo = fn.lower(put(f), put(g)).compile().as_text()
+    n_cp = hlo.count(" collective-permute(")
+    n_cp_start = hlo.count(" collective-permute-start(")
+    assert n_cp + n_cp_start == 2 * exchanged_dims * nfields
