@@ -1,1 +1,31 @@
-"""Example physics models built on the framework."""
+"""Model zoo: the reference's example applications, rebuilt TPU-first.
+
+Each model is a module with the same shape: ``setup`` (grid + fields + initial
+conditions), ``make_step`` (one fused SPMD time step), ``run`` (end-to-end).
+They correspond to the benchmark configs in `BASELINE.md`:
+
+* `diffusion3d` — 3-D heat diffusion (the reference's flagship example,
+  `/root/reference/examples/diffusion3D_*.jl`).
+* `acoustic3d` — 3-D acoustic wave on a staggered grid with comm/compute
+  overlap (BASELINE config 3).
+* `porous_convection3d` — pseudo-transient porous convection, the HydroMech3D
+  weak-scaling analogue (BASELINE config 4).
+
+Modules import lazily via ``__getattr__`` so ``import implicitglobalgrid_tpu``
+stays light.
+"""
+
+import importlib
+
+_MODELS = ("diffusion3d",)
+
+__all__ = list(_MODELS)
+
+
+def __getattr__(name):
+    if name in _MODELS:
+        try:
+            return importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from e
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,216 @@
+"""3-D heat diffusion — the flagship model (reference `examples/diffusion3D_*.jl`).
+
+The reference's headline application: heat diffusion with spatially variable
+heat capacity and two Gaussian anomalies, solved with a conservative
+finite-difference stencil on the implicit global grid
+(`/root/reference/examples/diffusion3D_multigpu_CuArrays_novis.jl:11-50`).
+The reference allocates explicit flux arrays (``qx, qy, qz, dTedt``) and runs
+five broadcast kernels plus `update_halo!` per step; here the whole time step
+is ONE fused XLA program per block — fluxes never hit HBM, and the halo
+exchange (`collective_permute`) is scheduled by XLA inside the same program.
+With ``hide_comm=True`` the boundary slabs are computed first so the exchange
+overlaps the interior update (the `@hide_communication` capability,
+reference `README.md:10`).
+
+Physics (reference lines :41-46):
+
+    q      = -lam * grad(T)              (Fourier's law, on the staggered flux grid)
+    dT/dt  = -(1/Cp) * div(q)            (conservation of energy)
+    T     += dt * dT/dt                  (explicit Euler, interior points only)
+
+Usage::
+
+    import implicitglobalgrid_tpu.models.diffusion3d as m
+    state, params = m.setup(nx=128, ny=128, nz=128)
+    step = m.make_step(params)
+    for _ in range(nt):
+        state = step(state)
+    T = m.temperature(state)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import numpy as np
+
+from .. import (
+    coord_fields,
+    finalize_global_grid,
+    init_global_grid,
+    nx_g,
+    ny_g,
+    nz_g,
+    stencil,
+    update_halo,
+    zeros,
+)
+from ..ops.overlap import hide_communication
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Physics + numerics of the run (reference lines :13-23,:39)."""
+
+    lam: float = 1.0  # thermal conductivity
+    cp_min: float = 1.0  # minimal heat capacity
+    lx: float = 10.0
+    ly: float = 10.0
+    lz: float = 10.0
+    dx: float = 0.0
+    dy: float = 0.0
+    dz: float = 0.0
+    dt: float = 0.0
+    dtype: Any = None
+    hide_comm: bool = False
+
+
+def _inn(A):
+    return A[1:-1, 1:-1, 1:-1]
+
+
+def _gaussians(X, Y, Z, params: Params, jnp):
+    """The reference's two pairs of Gaussian anomalies (lines :34-37)."""
+    lx, ly, lz = params.lx, params.ly, params.lz
+    cp = params.cp_min + (
+        5 * jnp.exp(-((X - lx / 1.5) ** 2) - (Y - ly / 2) ** 2 - (Z - lz / 1.5) ** 2)
+        + 5 * jnp.exp(-((X - lx / 3.0) ** 2) - (Y - ly / 2) ** 2 - (Z - lz / 1.5) ** 2)
+    )
+    t = 100 * jnp.exp(
+        -(((X - lx / 2) / 2) ** 2) - ((Y - ly / 2) / 2) ** 2 - ((Z - lz / 3.0) / 2) ** 2
+    ) + 50 * jnp.exp(
+        -(((X - lx / 2) / 2) ** 2) - ((Y - ly / 2) / 2) ** 2 - ((Z - lz / 1.5) / 2) ** 2
+    )
+    return cp, t
+
+
+def setup(
+    nx: int = 128,
+    ny: int = 128,
+    nz: int = 128,
+    *,
+    lam: float = 1.0,
+    cp_min: float = 1.0,
+    lx: float = 10.0,
+    ly: float = 10.0,
+    lz: float = 10.0,
+    dtype=None,
+    hide_comm: bool = False,
+    init_grid: bool = True,
+    **grid_kwargs,
+):
+    """Initialize the global grid (unless ``init_grid=False``) and the fields.
+
+    Returns ``(state, params)`` where ``state = (T, Cp)`` are global-block
+    fields with the reference's initial conditions (lines :34-37).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if init_grid:
+        init_global_grid(nx, ny, nz, **grid_kwargs)
+    if dtype is None:
+        dtype = jax.dtypes.canonicalize_dtype(float)
+    dx = lx / (nx_g() - 1)  # reference line :21-23
+    dy = ly / (ny_g() - 1)
+    dz = lz / (nz_g() - 1)
+    dt = min(dx * dx, dy * dy, dz * dz) * cp_min / lam / 8.1  # reference line :39
+    params = Params(
+        lam=lam, cp_min=cp_min, lx=lx, ly=ly, lz=lz,
+        dx=dx, dy=dy, dz=dz, dt=dt, dtype=dtype, hide_comm=hide_comm,
+    )
+    T = zeros((nx, ny, nz), dtype)
+    X, Y, Z = coord_fields(T, (dx, dy, dz), dtype=dtype)
+
+    @stencil
+    def init_ic(X, Y, Z):
+        cp, t = _gaussians(X, Y, Z, params, jnp)
+        return cp.astype(dtype), t.astype(dtype)
+
+    Cp, T = init_ic(X, Y, Z)
+    return (T, Cp), params
+
+
+def _diffusion_update(params: Params):
+    """Per-block, pure T update (no exchange): the reference's five broadcast
+    kernels (lines :41-45) fused into one expression."""
+    import jax.numpy as jnp
+
+    lam, dt = params.lam, params.dt
+    dx, dy, dz = params.dx, params.dy, params.dz
+
+    def update(T, Cp):
+        qx = -lam * jnp.diff(T[:, 1:-1, 1:-1], axis=0) / dx  # (nx-1, ny-2, nz-2)
+        qy = -lam * jnp.diff(T[1:-1, :, 1:-1], axis=1) / dy
+        qz = -lam * jnp.diff(T[1:-1, 1:-1, :], axis=2) / dz
+        dTdt = (1.0 / _inn(Cp)) * (
+            -jnp.diff(qx, axis=0) / dx
+            - jnp.diff(qy, axis=1) / dy
+            - jnp.diff(qz, axis=2) / dz
+        )
+        return T.at[1:-1, 1:-1, 1:-1].set(_inn(T) + dt * dTdt)
+
+    return update
+
+
+def make_step(params: Params, *, donate: bool = True):
+    """Build the jitted SPMD time step: ``(T, Cp) -> (T, Cp)``.
+
+    One call = one fused XLA program: stencil update + halo exchange
+    (+ overlap scheduling when ``params.hide_comm``).
+    """
+    update = _diffusion_update(params)
+
+    if params.hide_comm:
+        overlapped = hide_communication(update, radius=1)
+
+        def block_step(T, Cp):
+            return overlapped(T, Cp), Cp
+
+    else:
+
+        def block_step(T, Cp):
+            T = update(T, Cp)
+            T = update_halo(T)
+            return T, Cp
+
+    return stencil(block_step, donate_argnums=(0,) if donate else ())
+
+
+def run(
+    nt: int,
+    nx: int = 128,
+    ny: int = 128,
+    nz: int = 128,
+    *,
+    finalize: bool = True,
+    **setup_kwargs,
+):
+    """End-to-end run (the reference's ``diffusion3D()`` without visualization).
+
+    Returns the final global-block temperature field.
+    """
+    import jax
+
+    state, params = setup(nx, ny, nz, **setup_kwargs)
+    step = make_step(params)
+    # On the virtual CPU mesh, XLA's in-process collectives deadlock if too
+    # many asynchronously dispatched programs pile up; syncing each step costs
+    # nothing there and is skipped on real accelerators.
+    from ..parallel.grid import global_grid
+
+    sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+    for _ in range(nt):
+        state = step(*state)
+        if sync_every_step:
+            jax.block_until_ready(state)
+    T = jax.block_until_ready(state[0])
+    if finalize:
+        finalize_global_grid()
+    return T
+
+
+def temperature(state):
+    return state[0]

@@ -122,6 +122,8 @@ def _overlapped_update(update_fn, fields, radius, exchange):
             )
 
     def crop(x, d, lo, hi):
+        if d >= x.ndim:
+            return x
         return lax.slice_in_dim(x, lo, x.shape[d] - hi, axis=d)
 
     # -- 1. boundary slabs of the new state (one pair per halo dim) ----------
@@ -133,10 +135,14 @@ def _overlapped_update(update_fn, fields, radius, exchange):
     for d in hdims:
         w = W[d]
         n_min = min(f.shape[d] for f in fields if d < f.ndim)
+        # Fields of lower rank than d (e.g. a 2-D parameter field on a 3-D
+        # grid) have no extent in this dimension: pass them through whole.
         lo_in = [
             lax.slice_in_dim(
                 f, 0, min(w + radius + (f.shape[d] - n_min), f.shape[d]), axis=d
             )
+            if d < f.ndim
+            else f
             for f in fields
         ]
         hi_in = [
@@ -146,15 +152,20 @@ def _overlapped_update(update_fn, fields, radius, exchange):
                 f.shape[d],
                 axis=d,
             )
+            if d < f.ndim
+            else f
             for f in fields
         ]
         lo_out = update_fn(*lo_in)
         hi_out = update_fn(*hi_in)
         lo_out = (lo_out,) if single else tuple(lo_out)
         hi_out = (hi_out,) if single else tuple(hi_out)
-        lo_out = tuple(lax.slice_in_dim(o, 0, w, axis=d) for o in lo_out)
+        lo_out = tuple(
+            lax.slice_in_dim(o, 0, w, axis=d) if d < o.ndim else o for o in lo_out
+        )
         hi_out = tuple(
-            lax.slice_in_dim(o, o.shape[d] - w, o.shape[d], axis=d) for o in hi_out
+            lax.slice_in_dim(o, o.shape[d] - w, o.shape[d], axis=d) if d < o.ndim else o
+            for o in hi_out
         )
         slabs[d] = (lo_out, hi_out)
 
@@ -171,15 +182,17 @@ def _overlapped_update(update_fn, fields, radius, exchange):
     # -- 4a. assemble slabs + interior ---------------------------------------
     assembled = []
     for i, aval in enumerate(out_avals):
+        nd_out = len(aval.shape)
+        my_hdims = [d for d in hdims if d < nd_out]
         base = jnp.zeros(aval.shape, aval.dtype)
-        off = [0] * len(aval.shape)
-        for d in hdims:
+        off = [0] * nd_out
+        for d in my_hdims:
             off[d] = W[d]
         base = lax.dynamic_update_slice(base, int_out[i].astype(aval.dtype), off)
-        for d in hdims:
+        for d in my_hdims:
             lo_o, hi_o = slabs[d]
-            lo_off = [0] * len(aval.shape)
-            hi_off = [0] * len(aval.shape)
+            lo_off = [0] * nd_out
+            hi_off = [0] * nd_out
             hi_off[d] = aval.shape[d] - W[d]
             base = lax.dynamic_update_slice(base, lo_o[i].astype(aval.dtype), lo_off)
             base = lax.dynamic_update_slice(base, hi_o[i].astype(aval.dtype), hi_off)

@@ -139,3 +139,28 @@ def test_fields_constructors():
     assert float(np.asarray(f)[0]) == 2.5
     # sharding: one block per device along the mesh
     assert len(z.sharding.device_set) == 8
+
+
+def test_hide_communication_lower_rank_aux_field():
+    # A 2-D parameter field on a 3-D grid must pass through hide_communication
+    # windows whole (regression: IndexError in the slab/crop loops).
+    import jax
+    import jax.numpy as jnp
+
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    T = igg.from_block_fn(
+        lambda c: jnp.arange(8 * 8 * 8, dtype=jnp.float64).reshape(8, 8, 8)
+        * (1.0 + c[0] + 2 * c[1] + 4 * c[2]),
+        (8, 8, 8),
+    )
+    K2d = igg.ones((8, 8))  # no z axis
+
+    def update(T, K2d):
+        Tn = T.at[1:-1, 1:-1, 1:-1].set(
+            T[1:-1, 1:-1, 1:-1] * 0.5 + K2d[1:-1, 1:-1, None] * 0.25
+        )
+        return Tn
+
+    plain = igg.stencil(lambda T, K: igg.update_halo(update(T, K)))(T, K2d)
+    overlapped = igg.stencil(igg.hide_communication(update, radius=1))(T, K2d)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(overlapped))
