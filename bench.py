@@ -1,0 +1,81 @@
+"""Benchmark: 3-D heat diffusion effective memory throughput (T_eff) per chip.
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+
+T_eff follows the reference community's convention (ParallelStencil/IGG
+papers): the diffusion step *must* stream temperature once in and once out per
+iteration, so ``A_eff = 2 * nx*ny*nz * sizeof(dtype)`` and
+``T_eff = A_eff / t_it``.  This is a lower bound on achieved HBM traffic
+(reads of Cp and the halo exchange are free on top), making the number
+directly comparable across machines and implementations.
+
+Baseline: the reference publishes 510^3 on 8x P100 = local 256^3/GPU at 17.4
+ms/step for the broadcast version (100k steps / 29 min, `README.md:159-163`
+of the reference) => T_eff = 2*256^3*8 B / 17.4 ms = 15.4 GB/s, and states
+the optimized kernel version is ">10x faster" (`README.md:163`) => 154 GB/s
+per P100.  ``vs_baseline`` is measured T_eff / 154 GB/s.
+
+Run on the default backend (one real TPU chip under the driver; any JAX
+backend works).  Local grid 256^3 Float32 — the same per-chip problem as the
+reference's headline run, in TPU-native single precision.
+"""
+
+import json
+import time
+
+
+BASELINE_TEFF_GBS = 154.0  # reference optimized version, per P100 (see docstring)
+
+
+def _sync(state):
+    """Full synchronization: fetch one scalar (block_until_ready alone can
+    return early on remote-tunneled backends)."""
+    import jax
+
+    jax.block_until_ready(state)
+    float(state[0].ravel()[0])
+
+
+def bench_diffusion_teff(n: int = 256, chunk: int = 25, reps: int = 4):
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    state, params = diffusion3d.setup(
+        n, n, n, dtype=jax.numpy.float32, quiet=True
+    )
+    step = diffusion3d.make_multi_step(params, chunk)
+    state = step(*state)  # compile + warm up
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = step(*state)
+    _sync(state)
+    t_it = (time.perf_counter() - t0) / (reps * chunk)
+    igg.finalize_global_grid()
+
+    nprocs = len(jax.devices())
+    bytes_per_chip = 2 * n**3 * jax.numpy.dtype(params.dtype).itemsize
+    teff = bytes_per_chip / t_it / 1e9
+    return teff, t_it, nprocs
+
+
+def main():
+    teff, t_it, nprocs = bench_diffusion_teff()
+    print(
+        json.dumps(
+            {
+                "metric": "diffusion3d_256_f32_teff",
+                "value": round(teff, 2),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(teff / BASELINE_TEFF_GBS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

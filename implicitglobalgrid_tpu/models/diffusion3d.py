@@ -135,22 +135,30 @@ def setup(
 
 def _diffusion_update(params: Params):
     """Per-block, pure T update (no exchange): the reference's five broadcast
-    kernels (lines :41-45) fused into one expression."""
+    kernels (lines :41-45) fused into one expression.
+
+    Formulation chosen by measurement on TPU: with scalar conductivity the
+    flux divergence is the Laplacian, computed from interior slices and added
+    back as ``T + pad(delta, 1)`` — ~1.5x faster than the literal
+    flux-arrays + scatter-update translation (`.at[1:-1,...].set` lowers to an
+    unaligned dynamic-update-slice against the (8,128)-tiled layout, and the
+    intermediate flux arrays cost extra HBM passes).  The padded-delta form
+    also freezes the outermost ring (width 1 = stencil radius), exactly the
+    reference's boundary behavior.
+    """
     import jax.numpy as jnp
 
     lam, dt = params.lam, params.dt
     dx, dy, dz = params.dx, params.dy, params.dz
 
     def update(T, Cp):
-        qx = -lam * jnp.diff(T[:, 1:-1, 1:-1], axis=0) / dx  # (nx-1, ny-2, nz-2)
-        qy = -lam * jnp.diff(T[1:-1, :, 1:-1], axis=1) / dy
-        qz = -lam * jnp.diff(T[1:-1, 1:-1, :], axis=2) / dz
-        dTdt = (1.0 / _inn(Cp)) * (
-            -jnp.diff(qx, axis=0) / dx
-            - jnp.diff(qy, axis=1) / dy
-            - jnp.diff(qz, axis=2) / dz
+        lap = (
+            (T[2:, 1:-1, 1:-1] - 2 * _inn(T) + T[:-2, 1:-1, 1:-1]) / (dx * dx)
+            + (T[1:-1, 2:, 1:-1] - 2 * _inn(T) + T[1:-1, :-2, 1:-1]) / (dy * dy)
+            + (T[1:-1, 1:-1, 2:] - 2 * _inn(T) + T[1:-1, 1:-1, :-2]) / (dz * dz)
         )
-        return T.at[1:-1, 1:-1, 1:-1].set(_inn(T) + dt * dTdt)
+        delta = (dt * lam) / _inn(Cp) * lap
+        return T + jnp.pad(delta, 1)
 
     return update
 
@@ -175,6 +183,35 @@ def make_step(params: Params, *, donate: bool = True):
             T = update(T, Cp)
             T = update_halo(T)
             return T, Cp
+
+    return stencil(block_step, donate_argnums=(0,) if donate else ())
+
+
+def make_multi_step(params: Params, nsteps: int, *, donate: bool = True):
+    """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
+
+    TPU-first: the whole loop is one XLA program, so per-call dispatch
+    overhead amortizes away and the compiler schedules across iterations —
+    use this for production runs and benchmarks.
+    """
+    from jax import lax
+
+    update = _diffusion_update(params)
+
+    if params.hide_comm:
+        overlapped = hide_communication(update, radius=1)
+
+        def one(T, Cp):
+            return overlapped(T, Cp)
+
+    else:
+
+        def one(T, Cp):
+            return update_halo(update(T, Cp))
+
+    def block_step(T, Cp):
+        T = lax.fori_loop(0, nsteps, lambda i, T: one(T, Cp), T)
+        return T, Cp
 
     return stencil(block_step, donate_argnums=(0,) if donate else ())
 
