@@ -2,6 +2,10 @@
 
 Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
 
+Thin wrapper over `benchmarks/run.py` (the full harness — weak scaling,
+acoustic, porous configs live there); this entry point runs the headline
+config and adds the baseline ratio.
+
 T_eff follows the reference community's convention (ParallelStencil/IGG
 papers): the diffusion step *must* stream temperature once in and once out per
 iteration, so ``A_eff = 2 * nx*ny*nz * sizeof(dtype)`` and
@@ -20,58 +24,29 @@ backend works).  Local grid 256^3 Float32 — the same per-chip problem as the
 reference's headline run, in TPU-native single precision.
 """
 
+import importlib.util
 import json
-import time
-
+import os
 
 BASELINE_TEFF_GBS = 154.0  # reference optimized version, per P100 (see docstring)
 
-
-def _sync(state):
-    """Full synchronization: fetch one scalar (block_until_ready alone can
-    return early on remote-tunneled backends)."""
-    import jax
-
-    jax.block_until_ready(state)
-    float(state[0].ravel()[0])
-
-
-def bench_diffusion_teff(n: int = 256, chunk: int = 25, reps: int = 4):
-    import jax
-
-    import implicitglobalgrid_tpu as igg
-    from implicitglobalgrid_tpu.models import diffusion3d
-
-    if igg.grid_is_initialized():
-        igg.finalize_global_grid()
-    state, params = diffusion3d.setup(
-        n, n, n, dtype=jax.numpy.float32, quiet=True
-    )
-    step = diffusion3d.make_multi_step(params, chunk)
-    state = step(*state)  # compile + warm up
-    _sync(state)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        state = step(*state)
-    _sync(state)
-    t_it = (time.perf_counter() - t0) / (reps * chunk)
-    igg.finalize_global_grid()
-
-    nprocs = len(jax.devices())
-    bytes_per_chip = 2 * n**3 * jax.numpy.dtype(params.dtype).itemsize
-    teff = bytes_per_chip / t_it / 1e9
-    return teff, t_it, nprocs
+_here = os.path.dirname(os.path.abspath(__file__))
+_spec = importlib.util.spec_from_file_location(
+    "igg_benchmarks_run", os.path.join(_here, "benchmarks", "run.py")
+)
+_bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_bench)
 
 
 def main():
-    teff, t_it, nprocs = bench_diffusion_teff()
+    rec = _bench.bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", emit=False)
     print(
         json.dumps(
             {
-                "metric": "diffusion3d_256_f32_teff",
-                "value": round(teff, 2),
+                "metric": rec["metric"] + "_teff",
+                "value": rec["value"],
                 "unit": "GB/s/chip",
-                "vs_baseline": round(teff / BASELINE_TEFF_GBS, 3),
+                "vs_baseline": round(rec["value"] / BASELINE_TEFF_GBS, 3),
             }
         )
     )

@@ -1,0 +1,217 @@
+"""Benchmark harness: T_eff (GB/s/chip) and weak-scaling efficiency.
+
+The reference publishes only narrative numbers (`/root/reference/README.md:159-164`)
+— no benchmark code.  This harness ships the measurements as code so every
+number in `BASELINE.md` is reproducible.  One JSON line per config on stdout.
+
+Configs (BASELINE.json):
+
+    diffusion        3-D heat diffusion (configs 1, 2, 5 via --n/--dtype/mesh)
+    acoustic         3-D acoustic staggered FDTD, overlap on/off (config 3)
+    porous           porous convection PT solver (config 4, HydroMech analogue)
+    weak             weak-scaling efficiency over sub-meshes of the available
+                     devices (same local size per device, t(1)/t(N))
+
+T_eff convention (ParallelStencil/IGG papers): only arrays that *must* stream
+once per iteration count — temperature in+out for diffusion (2 passes);
+P,V in+out for acoustic (8); fluxes+pressure in+out per PT iteration for
+porous (8) — times local cells per chip, divided by measured time.
+
+Usage:
+    python benchmarks/run.py [diffusion|acoustic|porous|weak|all]
+        [--n 256] [--steps 100] [--chunk 25] [--dtype float32] [--hide-comm]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _sync(state):
+    import jax
+
+    jax.block_until_ready(state)
+    leaf = state[0] if isinstance(state, (tuple, list)) else state
+    # Fetch ONE element of the process-local shard: block_until_ready alone
+    # can lie on tunneled backends, fetching the global array would fail on
+    # multi-host (non-addressable) meshes, and fetching the whole shard would
+    # put MBs of transfer inside the timed region.
+    shard = leaf.addressable_shards[0].data
+    float(shard[(0,) * shard.ndim])
+
+
+def _time_steps(step, state, chunk: int, reps: int):
+    state = step(*state)  # compile + warmup
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = step(*state)
+    _sync(state)
+    return (time.perf_counter() - t0) / (reps * chunk), state
+
+
+def _emit(name, teff, t_it, extra=None, emit=True):
+    rec = {
+        "metric": name,
+        "value": round(teff, 2),
+        "unit": "GB/s/chip",
+        "t_it_ms": round(t_it * 1e3, 4),
+    }
+    if extra:
+        rec.update(extra)
+    if emit:
+        print(json.dumps(rec), flush=True)
+    return rec
+
+
+def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
+                    devices=None, emit=True):
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    state, params = diffusion3d.setup(
+        n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
+        devices=devices,
+    )
+    step = diffusion3d.make_multi_step(params, chunk)
+    t_it, state = _time_steps(step, state, chunk, reps)
+    gg = igg.get_global_grid()
+    igg.finalize_global_grid()
+    nbytes = 2 * n**3 * jax.numpy.dtype(dtype).itemsize
+    return _emit(
+        f"diffusion3d_{n}_{dtype}" + ("_overlap" if hide_comm else ""),
+        nbytes / t_it / 1e9,
+        t_it,
+        {"dims": list(gg.dims), "nprocs": gg.nprocs},
+        emit=emit,
+    )
+
+
+def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None):
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import acoustic3d
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    state, params = acoustic3d.setup(
+        n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
+        devices=devices,
+    )
+    step = acoustic3d.make_multi_step(params, chunk)
+    t_it, state = _time_steps(step, state, chunk, reps)
+    gg = igg.get_global_grid()
+    igg.finalize_global_grid()
+    nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize  # P,Vx,Vy,Vz in+out
+    return _emit(
+        f"acoustic3d_{n}_{dtype}" + ("_overlap" if hide_comm else ""),
+        nbytes / t_it / 1e9,
+        t_it,
+        {"dims": list(gg.dims), "nprocs": gg.nprocs},
+    )
+
+
+def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None):
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import porous_convection3d as pc
+
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    state, params = pc.setup(
+        n, n, n, dtype=jax.numpy.dtype(dtype), npt=npt, quiet=True, devices=devices
+    )
+    step = pc.make_step(params)
+
+    def multi(*s):
+        for _ in range(chunk):
+            s = step(*s)
+        return s
+
+    t_step, state = _time_steps(multi, state, chunk, reps)
+    gg = igg.get_global_grid()
+    igg.finalize_global_grid()
+    # Per PT iteration: qDx,qDy,qDz,Pf in+out = 8 array passes.
+    t_pt = t_step / npt
+    nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize
+    return _emit(
+        f"porous_convection3d_{n}_{dtype}_npt{npt}",
+        nbytes / t_pt / 1e9,
+        t_step,
+        {"dims": list(gg.dims), "nprocs": gg.nprocs, "t_pt_ms": round(t_pt * 1e3, 4)},
+    )
+
+
+def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False):
+    """Weak scaling: same local n^3 per device on growing sub-meshes.
+
+    Parallel efficiency = t(1 device) / t(N devices); ~1.0 means the halo
+    exchange is fully hidden or negligible.
+    """
+    import jax
+
+    devs = jax.devices()
+    counts = []
+    c = 1
+    while c <= len(devs):
+        counts.append(c)
+        c *= 2
+    results = {}
+    for c in counts:
+        rec = bench_diffusion(
+            n=n, chunk=chunk, reps=reps, dtype=dtype, hide_comm=hide_comm,
+            devices=devs[:c],
+        )
+        results[c] = rec["t_it_ms"]
+    base = results[1]
+    effs = {c: round(base / t, 4) for c, t in results.items()}
+    print(
+        json.dumps(
+            {
+                "metric": f"weak_scaling_diffusion3d_{n}_{dtype}"
+                + ("_overlap" if hide_comm else ""),
+                "value": effs[counts[-1]],
+                "unit": "parallel_efficiency",
+                "per_count": effs,
+            }
+        ),
+        flush=True,
+    )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("what", nargs="?", default="all",
+                   choices=["diffusion", "acoustic", "porous", "weak", "all"])
+    p.add_argument("--n", type=int, default=None)
+    p.add_argument("--chunk", type=int, default=25)
+    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--hide-comm", action="store_true")
+    p.add_argument("--npt", type=int, default=10)
+    a = p.parse_args()
+    kw = dict(chunk=a.chunk, reps=a.reps, dtype=a.dtype)
+    if a.what in ("diffusion", "all"):
+        bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, **kw)
+    if a.what in ("acoustic", "all"):
+        bench_acoustic(n=a.n or 192, hide_comm=a.hide_comm, **kw)
+    if a.what in ("porous", "all"):
+        # porous steps contain npt inner iterations, so the outer chunk stays
+        # small unless the user asked for porous explicitly
+        porous_chunk = a.chunk if a.what == "porous" else 4
+        bench_porous(n=a.n or 128, chunk=porous_chunk, reps=a.reps, npt=a.npt, dtype=a.dtype)
+    if a.what in ("weak", "all"):
+        bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,
+                           dtype=a.dtype, hide_comm=a.hide_comm)
+
+
+if __name__ == "__main__":
+    main()

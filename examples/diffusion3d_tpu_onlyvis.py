@@ -1,0 +1,42 @@
+"""Visualization pattern only — the solver lines are elided.
+
+Port of `/root/reference/examples/diffusion3D_multigpu_CuArrays_onlyvis.jl`,
+which documents just the in-situ visualization recipe: every ``nvis`` steps,
+strip the halo locally, gather the blocks to process 0, and render the
+mid-plane.  See `diffusion3d_multidevice.py` for the complete solver.
+"""
+
+import numpy as np
+
+import implicitglobalgrid_tpu as igg
+
+
+def diffusion3d():
+    # Physics
+    # (...)
+
+    # Numerics
+    # (...)
+    me, dims, nprocs, coords, mesh = igg.init_global_grid(nx, ny, nz)  # noqa: F821
+    # (...)
+
+    # Array initializations + initial conditions
+    # (...)
+
+    # Preparation of visualization: the gathered array is the halo-stripped
+    # blocks side by side — (n-2)*dims cells per dimension.
+    frames = []
+    ny_v = (ny - 2) * dims[1]  # noqa: F821
+
+    # Time loop
+    for it in range(nt):  # noqa: F821
+        if it % 1000 == 0:  # visualize every 1000th step
+            T_nohalo = igg.block_slice(T, (slice(1, -1),) * 3)  # noqa: F821  strip halo locally
+            T_v = igg.gather(T_nohalo)  # gather on process 0
+            if me == 0:
+                frames.append(np.array(T_v[:, ny_v // 2, :]).T)  # mid-plane heatmap frame
+        # (... stencil update + update_halo ...)
+
+    # Postprocessing: write frames to GIF/MP4 on process 0.
+    # (...)
+    igg.finalize_global_grid()

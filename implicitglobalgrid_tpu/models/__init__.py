@@ -17,7 +17,7 @@ stays light.
 
 import importlib
 
-_MODELS = ("diffusion3d",)
+_MODELS = ("diffusion3d", "acoustic3d", "porous_convection3d")
 
 __all__ = list(_MODELS)
 

@@ -1,0 +1,228 @@
+"""3-D acoustic wave on a staggered grid with comm/compute overlap.
+
+BASELINE config 3: velocity–pressure acoustic FDTD, the canonical *staggered*
+application of the reference's grid machinery (staggered fields of shape
+``n+1`` on one topology are the reference's test-pinned feature,
+`/root/reference/test/test_update_halo.jl:828-937`; the solver structure
+follows the acoustic miniapp of the reference's sister package
+ParallelStencil, referenced at `/root/reference/README.md:10`).
+
+Grid layout (one cell = one pressure point):
+
+* ``P``  at cell centers, local shape ``(nx,   ny,   nz)``
+* ``Vx`` on x-faces,      local shape ``(nx+1, ny,   nz)``
+* ``Vy`` on y-faces,      local shape ``(nx,   ny+1, nz)``
+* ``Vz`` on z-faces,      local shape ``(nx,   ny,   nz+1)``
+
+Update (explicit leapfrog):
+
+    V  -= dt/rho * grad(P)      (interior face points)
+    P  -= dt*K   * div(V)       (all cell centers)
+
+Only the velocity fields exchange halos: ``P`` is recomputed everywhere from
+post-exchange velocities, so its boundary planes are always fresh — one
+3-field `update_halo` per step instead of four.  With ``hide_comm=True`` the
+exchange of the velocity slabs overlaps the interior velocity update
+(`hide_communication`), the reference's `@hide_communication` capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from .. import (
+    coord_fields,
+    finalize_global_grid,
+    init_global_grid,
+    stencil,
+    update_halo,
+    zeros,
+)
+from ..ops.overlap import hide_communication
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    K: float = 1.0  # bulk modulus
+    rho: float = 1.0  # density
+    lx: float = 10.0
+    ly: float = 10.0
+    lz: float = 10.0
+    dx: float = 0.0
+    dy: float = 0.0
+    dz: float = 0.0
+    dt: float = 0.0
+    dtype: Any = None
+    hide_comm: bool = False
+
+
+def _inn(A):
+    return A[1:-1, 1:-1, 1:-1]
+
+
+def setup(
+    nx: int = 64,
+    ny: int = 64,
+    nz: int = 64,
+    *,
+    K: float = 1.0,
+    rho: float = 1.0,
+    lx: float = 10.0,
+    ly: float = 10.0,
+    lz: float = 10.0,
+    dtype=None,
+    hide_comm: bool = False,
+    init_grid: bool = True,
+    **grid_kwargs,
+):
+    """Initialize grid + fields; a Gaussian pressure pulse at the domain center.
+
+    Returns ``(state, params)`` with ``state = (P, Vx, Vy, Vz)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils import tools
+
+    if init_grid:
+        init_global_grid(nx, ny, nz, **grid_kwargs)
+    if dtype is None:
+        dtype = jax.dtypes.canonicalize_dtype(float)
+    dx = lx / (tools.nx_g() - 1)
+    dy = ly / (tools.ny_g() - 1)
+    dz = lz / (tools.nz_g() - 1)
+    c = (K / rho) ** 0.5
+    dt = min(dx, dy, dz) / c / 2.0  # CFL (3-D bound is 1/sqrt(3); 1/2 for margin)
+    params = Params(
+        K=K, rho=rho, lx=lx, ly=ly, lz=lz, dx=dx, dy=dy, dz=dz, dt=dt,
+        dtype=dtype, hide_comm=hide_comm,
+    )
+
+    P = zeros((nx, ny, nz), dtype)
+    X, Y, Z = coord_fields(P, (dx, dy, dz), dtype=dtype)
+
+    @stencil
+    def init_ic(X, Y, Z):
+        p0 = 100 * jnp.exp(
+            -(((X - lx / 2) / 1.0) ** 2)
+            - ((Y - ly / 2) / 1.0) ** 2
+            - ((Z - lz / 2) / 1.0) ** 2
+        )
+        return p0.astype(dtype)
+
+    P = init_ic(X, Y, Z)
+    Vx = zeros((nx + 1, ny, nz), dtype)
+    Vy = zeros((nx, ny + 1, nz), dtype)
+    Vz = zeros((nx, ny, nz + 1), dtype)
+    return (P, Vx, Vy, Vz), params
+
+
+def _velocity_update(params: Params):
+    """Pure per-block velocity update (no exchange): interior face points only
+    (padded-delta form — boundary faces frozen, the rigid-wall condition)."""
+    import jax.numpy as jnp
+
+    a = params.dt / params.rho
+
+    def update(P, Vx, Vy, Vz):
+        dVx = -(a / params.dx) * jnp.diff(P[:, 1:-1, 1:-1], axis=0)  # (nx-1,ny-2,nz-2)
+        dVy = -(a / params.dy) * jnp.diff(P[1:-1, :, 1:-1], axis=1)
+        dVz = -(a / params.dz) * jnp.diff(P[1:-1, 1:-1, :], axis=2)
+        Vx = Vx + jnp.pad(dVx, 1)  # interior of (nx+1,ny,nz)
+        Vy = Vy + jnp.pad(dVy, 1)
+        Vz = Vz + jnp.pad(dVz, 1)
+        return Vx, Vy, Vz
+
+    return update
+
+
+def _pressure_update(params: Params):
+    """Pure per-block pressure update: all centers, from fresh velocities."""
+    import jax.numpy as jnp
+
+    b = params.dt * params.K
+
+    def update(P, Vx, Vy, Vz):
+        div = (
+            jnp.diff(Vx, axis=0) / params.dx
+            + jnp.diff(Vy, axis=1) / params.dy
+            + jnp.diff(Vz, axis=2) / params.dz
+        )
+        return P - b * div
+
+    return update
+
+
+def make_step(params: Params, *, donate: bool = True):
+    """One fused SPMD leapfrog step: ``(P, Vx, Vy, Vz) -> (P, Vx, Vy, Vz)``."""
+    v_update = _velocity_update(params)
+    p_update = _pressure_update(params)
+
+    if params.hide_comm:
+        overlapped = hide_communication(v_update, radius=1)
+
+        def block_step(P, Vx, Vy, Vz):
+            Vx, Vy, Vz = overlapped(P, Vx, Vy, Vz)
+            P = p_update(P, Vx, Vy, Vz)
+            return P, Vx, Vy, Vz
+
+    else:
+
+        def block_step(P, Vx, Vy, Vz):
+            Vx, Vy, Vz = v_update(P, Vx, Vy, Vz)
+            Vx, Vy, Vz = update_halo(Vx, Vy, Vz)
+            P = p_update(P, Vx, Vy, Vz)
+            return P, Vx, Vy, Vz
+
+    donate_argnums = tuple(range(4)) if donate else ()
+    return stencil(block_step, donate_argnums=donate_argnums)
+
+
+def make_multi_step(params: Params, nsteps: int, *, donate: bool = True):
+    """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`)."""
+    from jax import lax
+
+    v_update = _velocity_update(params)
+    p_update = _pressure_update(params)
+    if params.hide_comm:
+        v_exchange = hide_communication(v_update, radius=1)
+    else:
+
+        def v_exchange(P, Vx, Vy, Vz):
+            return update_halo(*v_update(P, Vx, Vy, Vz))
+
+    def block_step(P, Vx, Vy, Vz):
+        def body(i, s):
+            P, Vx, Vy, Vz = s
+            Vx, Vy, Vz = v_exchange(P, Vx, Vy, Vz)
+            P = p_update(P, Vx, Vy, Vz)
+            return (P, Vx, Vy, Vz)
+
+        return lax.fori_loop(0, nsteps, body, (P, Vx, Vy, Vz))
+
+    donate_argnums = tuple(range(4)) if donate else ()
+    return stencil(block_step, donate_argnums=donate_argnums)
+
+
+def run(nt: int, nx: int = 64, ny: int = 64, nz: int = 64, *, finalize: bool = True, **kw):
+    """End-to-end run; returns the final global-block pressure field."""
+    import jax
+
+    from ..parallel.grid import global_grid
+
+    state, params = setup(nx, ny, nz, **kw)
+    step = make_step(params)
+    sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+    for _ in range(nt):
+        state = step(*state)
+        if sync_every_step:
+            jax.block_until_ready(state)
+    P = jax.block_until_ready(state[0])
+    if finalize:
+        finalize_global_grid()
+    return P
+
+
+def pressure(state):
+    return state[0]

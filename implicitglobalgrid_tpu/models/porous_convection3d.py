@@ -1,0 +1,262 @@
+"""3-D porous convection — the HydroMech3D weak-scaling analogue.
+
+BASELINE config 4.  The reference's headline scaling result is a multi-physics
+hydro-mechanical solver built on its grid (`/root/reference/README.md:6-8`);
+the publicly documented miniapp of that family is pseudo-transient porous
+convection (Darcy flow + temperature advection-diffusion, the
+PorousConvection3D miniapp of the reference's ecosystem).  This module builds
+it TPU-first:
+
+* **Pseudo-transient pressure solve**: each time step runs ``npt`` relaxation
+  iterations of the Darcy flux / fluid pressure pair inside `lax.fori_loop` —
+  the whole inner solver is ONE XLA program with a halo exchange per
+  iteration, the communication pattern that dominates the reference's
+  weak-scaling benchmark.
+* **Staggered fields**: Darcy fluxes live on cell faces (``n+1`` shapes).
+* **Buoyancy** (Boussinesq): ``qD = -k/eta * (grad(Pf) - Ra_hat * T * e_z)``.
+* **Temperature**: explicit upwind advection + diffusion, interior update +
+  halo exchange; frozen boundary planes give Dirichlet walls in z (hot
+  bottom / cold top) and fixed side walls.
+
+State: ``(T, Pf, qDx, qDy, qDz)``, all global-block fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from .. import (
+    coord_fields,
+    finalize_global_grid,
+    init_global_grid,
+    stencil,
+    update_halo,
+    zeros,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    Ra: float = 1000.0  # Rayleigh number
+    lx: float = 2.0
+    ly: float = 1.0
+    lz: float = 1.0
+    dT: float = 1.0  # temperature contrast bottom-top
+    phi: float = 0.1  # porosity
+    lam_T: float = 1.0 / 1000.0  # effective thermal diffusivity (lam/rhoCp = 1/Ra)
+    dx: float = 0.0
+    dy: float = 0.0
+    dz: float = 0.0
+    dt: float = 0.0
+    theta_q: float = 0.5  # PT relaxation for fluxes
+    beta_p: float = 0.0  # PT relaxation for pressure (set in setup, see bound below)
+    npt: int = 20  # PT iterations per time step
+    dtype: Any = None
+
+
+def _inn(A):
+    return A[1:-1, 1:-1, 1:-1]
+
+
+def setup(
+    nx: int = 32,
+    ny: int = 32,
+    nz: int = 32,
+    *,
+    Ra: float = 1000.0,
+    lx: float = 2.0,
+    ly: float = 1.0,
+    lz: float = 1.0,
+    dT: float = 1.0,
+    npt: int = 20,
+    dtype=None,
+    init_grid: bool = True,
+    **grid_kwargs,
+):
+    """Grid + fields: linear conductive T profile with a central Gaussian
+    perturbation (the standard porous-convection initial condition), zero
+    pressure and fluxes.  Returns ``(state, params)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils import tools
+
+    if init_grid:
+        init_global_grid(nx, ny, nz, **grid_kwargs)
+    if dtype is None:
+        dtype = jax.dtypes.canonicalize_dtype(float)
+    dx = lx / (tools.nx_g() - 1)
+    dy = ly / (tools.ny_g() - 1)
+    dz = lz / (tools.nz_g() - 1)
+    lam_T = 1.0 / Ra
+    dmin = min(dx, dy, dz)
+    # Fixed dt bounded by both explicit limits (miniapp simplification of the
+    # adaptive dt used in the reference ecosystem): diffusive dmin^2/lam/8.1,
+    # and advective phi*dmin/(3*q_scale) with the buoyancy-limited flux scale
+    # q_scale = Ra*lam_T*dT.
+    phi = 0.1
+    q_scale = Ra * lam_T * dT
+    dt = min(dmin**2 / lam_T / 8.1, phi * dmin / (3.0 * q_scale))
+    # Pressure relaxation: von Neumann bound for the (theta, beta) PT pair is
+    # beta*theta*k^2 <= 2 with the 3-D staggered-Laplacian spectral bound
+    # k^2 <= 4*(1/dx^2 + 1/dy^2 + 1/dz^2).
+    theta_q = 0.5
+    k2_max = 4.0 * (1.0 / dx**2 + 1.0 / dy**2 + 1.0 / dz**2)
+    beta_p = 0.9 * 2.0 / (theta_q * k2_max)
+    params = Params(
+        Ra=Ra, lx=lx, ly=ly, lz=lz, dT=dT, phi=phi, lam_T=lam_T,
+        dx=dx, dy=dy, dz=dz, dt=dt, theta_q=theta_q, beta_p=beta_p,
+        npt=int(npt), dtype=dtype,
+    )
+
+    T0 = zeros((nx, ny, nz), dtype)
+    X, Y, Z = coord_fields(T0, (dx, dy, dz), dtype=dtype)
+
+    @stencil
+    def init_ic(X, Y, Z):
+        # Conductive profile: +dT/2 at z=0 (hot bottom) to -dT/2 at z=lz.
+        prof = dT / 2 - dT * Z / lz
+        pert = (
+            0.1
+            * dT
+            * jnp.exp(
+                -(((X - lx / 2) / 0.1) ** 2)
+                - ((Y - ly / 2) / 0.1) ** 2
+                - ((Z - lz / 2) / 0.1) ** 2
+            )
+        )
+        return (prof + pert).astype(dtype)
+
+    T = init_ic(X, Y, Z)
+    Pf = zeros((nx, ny, nz), dtype)
+    qDx = zeros((nx + 1, ny, nz), dtype)
+    qDy = zeros((nx, ny + 1, nz), dtype)
+    qDz = zeros((nx, ny, nz + 1), dtype)
+    return (T, Pf, qDx, qDy, qDz), params
+
+
+def _pt_iteration(params: Params):
+    """One pseudo-transient Darcy relaxation: flux update (+buoyancy), halo
+    exchange of the fluxes, pressure update.  Pf needs no exchange — it is
+    recomputed at every cell from post-exchange fluxes (same argument as the
+    acoustic model's pressure)."""
+    import jax.numpy as jnp
+
+    th = params.theta_q
+    bp = params.beta_p
+    dx, dy, dz = params.dx, params.dy, params.dz
+
+    def av_z_to_face(T):
+        # T averaged onto interior z-faces: (nx-2, ny-2, nz-1)
+        return 0.5 * (T[1:-1, 1:-1, 1:] + T[1:-1, 1:-1, :-1])
+
+    def iteration(T, Pf, qDx, qDy, qDz):
+        # Darcy flux relaxation toward -grad(Pf) + Ra*T e_z (interior faces).
+        fx = -jnp.diff(Pf[:, 1:-1, 1:-1], axis=0) / dx
+        fy = -jnp.diff(Pf[1:-1, :, 1:-1], axis=1) / dy
+        fz = -jnp.diff(Pf[1:-1, 1:-1, :], axis=2) / dz + params.Ra * params.lam_T * av_z_to_face(T)
+        qDx = qDx + jnp.pad(th * (fx - _inn(qDx)), 1)
+        qDy = qDy + jnp.pad(th * (fy - _inn(qDy)), 1)
+        qDz = qDz + jnp.pad(th * (fz - _inn(qDz)), 1)
+        qDx, qDy, qDz = update_halo(qDx, qDy, qDz)
+        div = (
+            jnp.diff(qDx, axis=0) / dx
+            + jnp.diff(qDy, axis=1) / dy
+            + jnp.diff(qDz, axis=2) / dz
+        )
+        Pf = Pf - bp * div
+        return Pf, qDx, qDy, qDz
+
+    return iteration
+
+
+def _temperature_update(params: Params):
+    """Explicit upwind advection + diffusion of T (interior), frozen walls."""
+    import jax.numpy as jnp
+
+    dx, dy, dz = params.dx, params.dy, params.dz
+    lam = params.lam_T
+    iphi = 1.0 / params.phi
+    dt = params.dt
+
+    def update(T, qDx, qDy, qDz):
+        lap = (
+            (T[2:, 1:-1, 1:-1] - 2 * _inn(T) + T[:-2, 1:-1, 1:-1]) / (dx * dx)
+            + (T[1:-1, 2:, 1:-1] - 2 * _inn(T) + T[1:-1, :-2, 1:-1]) / (dy * dy)
+            + (T[1:-1, 1:-1, 2:] - 2 * _inn(T) + T[1:-1, 1:-1, :-2]) / (dz * dz)
+        )
+        # Upwind advective derivatives at interior cells from face fluxes.
+        qxm = qDx[1:-2, 1:-1, 1:-1]  # face below cell (x), interior cells
+        qxp = qDx[2:-1, 1:-1, 1:-1]  # face above cell (x)
+        qym = qDy[1:-1, 1:-2, 1:-1]
+        qyp = qDy[1:-1, 2:-1, 1:-1]
+        qzm = qDz[1:-1, 1:-1, 1:-2]
+        qzp = qDz[1:-1, 1:-1, 2:-1]
+        dTm_x = (_inn(T) - T[:-2, 1:-1, 1:-1]) / dx
+        dTp_x = (T[2:, 1:-1, 1:-1] - _inn(T)) / dx
+        dTm_y = (_inn(T) - T[1:-1, :-2, 1:-1]) / dy
+        dTp_y = (T[1:-1, 2:, 1:-1] - _inn(T)) / dy
+        dTm_z = (_inn(T) - T[1:-1, 1:-1, :-2]) / dz
+        dTp_z = (T[1:-1, 1:-1, 2:] - _inn(T)) / dz
+        adv = (
+            jnp.maximum(qxm, 0.0) * dTm_x
+            + jnp.minimum(qxp, 0.0) * dTp_x
+            + jnp.maximum(qym, 0.0) * dTm_y
+            + jnp.minimum(qyp, 0.0) * dTp_y
+            + jnp.maximum(qzm, 0.0) * dTm_z
+            + jnp.minimum(qzp, 0.0) * dTp_z
+        )
+        dTdt = lam * lap - iphi * adv
+        return T + jnp.pad(dt * dTdt, 1)
+
+    return update
+
+
+def make_step(params: Params, *, donate: bool = True):
+    """One time step: ``npt`` PT pressure iterations (fori_loop) + T update.
+
+    The inner loop, its per-iteration 3-field halo exchange, the temperature
+    update and its exchange compile into one XLA program per block.
+    """
+    from jax import lax
+
+    pt_iter = _pt_iteration(params)
+    t_update = _temperature_update(params)
+    npt = params.npt
+
+    def block_step(T, Pf, qDx, qDy, qDz):
+        def body(i, s):
+            Pf, qDx, qDy, qDz = s
+            return pt_iter(T, Pf, qDx, qDy, qDz)
+
+        Pf, qDx, qDy, qDz = lax.fori_loop(0, npt, body, (Pf, qDx, qDy, qDz))
+        T = t_update(T, qDx, qDy, qDz)
+        T = update_halo(T)
+        return T, Pf, qDx, qDy, qDz
+
+    donate_argnums = tuple(range(5)) if donate else ()
+    return stencil(block_step, donate_argnums=donate_argnums)
+
+
+def run(nt: int, nx: int = 32, ny: int = 32, nz: int = 32, *, finalize: bool = True, **kw):
+    """End-to-end run; returns the final global-block temperature field."""
+    import jax
+
+    from ..parallel.grid import global_grid
+
+    state, params = setup(nx, ny, nz, **kw)
+    step = make_step(params)
+    sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+    for _ in range(nt):
+        state = step(*state)
+        if sync_every_step:
+            jax.block_until_ready(state)
+    T = jax.block_until_ready(state[0])
+    if finalize:
+        finalize_global_grid()
+    return T
+
+
+def temperature(state):
+    return state[0]

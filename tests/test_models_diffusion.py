@@ -109,3 +109,18 @@ def test_anomaly_diffuses():
     igg.finalize_global_grid()
     assert T1.max() < T0.max()
     assert T1.min() >= -1e-9
+
+
+def test_multi_step_matches_single_steps():
+    nx = 10
+    state, params = diffusion3d.setup(nx, nx, nx)
+    step = diffusion3d.make_step(params, donate=False)
+    multi = diffusion3d.make_multi_step(params, 6, donate=False)
+    s1 = state
+    for _ in range(6):
+        s1 = jax.block_until_ready(step(*s1))
+    s6 = jax.block_until_ready(multi(*state))
+    np.testing.assert_allclose(
+        np.asarray(s1[0]), np.asarray(s6[0]), rtol=1e-12, atol=1e-13
+    )
+    igg.finalize_global_grid()
