@@ -164,6 +164,8 @@ def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False
     while c <= len(devs):
         counts.append(c)
         c *= 2
+    if counts[-1] != len(devs):  # non-power-of-two: still measure the full mesh
+        counts.append(len(devs))
     results = {}
     for c in counts:
         rec = bench_diffusion(

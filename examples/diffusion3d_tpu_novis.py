@@ -13,7 +13,6 @@ Run:
 import argparse
 import importlib.util
 import os
-import sys
 
 _here = os.path.dirname(os.path.abspath(__file__))
 _spec = importlib.util.spec_from_file_location(

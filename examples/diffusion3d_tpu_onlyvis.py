@@ -6,6 +6,11 @@ strip the halo locally, gather the blocks to process 0, and render the
 mid-plane.  See `diffusion3d_multidevice.py` for the complete solver.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import implicitglobalgrid_tpu as igg

@@ -33,6 +33,7 @@ from .parallel.grid import (
     global_grid,
     grid_is_initialized,
     init_global_grid,
+    profile_trace,
     select_device,
     set_global_grid,
     tic,
@@ -71,6 +72,7 @@ __all__ = [
     "z_g",
     "tic",
     "toc",
+    "profile_trace",
     # grid state
     "GlobalGrid",
     "global_grid",

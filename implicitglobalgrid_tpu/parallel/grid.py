@@ -18,6 +18,7 @@ de-duplicated global grid is never materialized (except by `gather`).
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import dataclasses
 import os
 import time
@@ -109,15 +110,16 @@ def init_global_grid(
     periodx: int = 0,
     periody: int = 0,
     periodz: int = 0,
-    overlapx: int = 2,
-    overlapy: int = 2,
-    overlapz: int = 2,
+    overlapx: int | None = None,
+    overlapy: int | None = None,
+    overlapz: int | None = None,
     disp: int = 1,
-    reorder: int = 1,
+    reorder: int | None = None,
     devices=None,
-    device_type: str = DEVICE_TYPE_AUTO,
+    device_type: str | None = None,
+    init_distributed: bool = False,
     select_device: bool = True,
-    quiet: bool = False,
+    quiet: bool | None = None,
 ):
     """Initialize the Cartesian device topology, implicitly defining a global grid.
 
@@ -128,14 +130,37 @@ def init_global_grid(
     (``reorder=1`` aligns mesh axes with the ICI torus), and the implicit
     global size is derived as ``dims*(nxyz-overlaps) + overlaps*(periods==0)``.
 
+    Configuration tiers (reference: src/init_global_grid.jl:40,51-68):
+    explicit kwargs > ``IGG_*`` env vars (`utils.config.env_config`) >
+    defaults.  ``init_distributed=True`` (the reference's ``init_MPI``) brings
+    up the JAX multi-host runtime first; ``devices`` (the reference's
+    ``comm``) restricts the grid to a device subset.
+
     Returns ``(me, dims, nprocs, coords, mesh)`` — the mesh takes the place of
     the reference's Cartesian communicator in the return tuple.
     """
     global _epoch
     import jax
 
+    from ..utils.config import env_config
+
     if grid_is_initialized():
         raise RuntimeError("The global grid has already been initialized.")
+    # Env tier (reference: src/init_global_grid.jl:51-68): kwargs > env > defaults.
+    env = env_config()
+    env_overlap = env.get("overlap", 2)
+    overlapx = env_overlap if overlapx is None else overlapx
+    overlapy = env_overlap if overlapy is None else overlapy
+    overlapz = env_overlap if overlapz is None else overlapz
+    reorder = env.get("reorder", 1) if reorder is None else reorder
+    device_type = env.get("device_type", DEVICE_TYPE_AUTO) if device_type is None else device_type
+    quiet = env.get("quiet", False) if quiet is None else quiet
+    if init_distributed:
+        # The reference's `init_MPI=true` analogue: bring up the multi-host
+        # runtime before touching devices (src/init_global_grid.jl:78-83).
+        from . import distributed as _distributed
+
+        _distributed.init_distributed()
     nxyz = [int(nx), int(ny), int(nz)]
     dims = [int(dimx), int(dimy), int(dimz)]
     periods = [int(periodx), int(periody), int(periodz)]
@@ -312,3 +337,22 @@ def init_timing_functions() -> None:
     # (reference: src/init_global_grid.jl:97,102-105).
     tic()
     toc()
+
+
+@_contextlib.contextmanager
+def profile_trace(logdir, **kwargs):
+    """Profiler hook: record a `jax.profiler` trace of the enclosed block.
+
+    The reference's only instrumentation is `tic`/`toc`
+    (`/root/reference/src/tools.jl:230-236`); on TPU the runtime ships a full
+    tracer for free, so this wraps the timed region for TensorBoard/Perfetto::
+
+        with igg.profile_trace("/tmp/igg-trace"):
+            for _ in range(100):
+                state = step(*state)
+        # inspect HLO ops, collective-permute overlap, HBM traffic per op
+    """
+    import jax
+
+    with jax.profiler.trace(str(logdir), **kwargs):
+        yield

@@ -1,0 +1,55 @@
+"""Environment-variable configuration tier.
+
+The reference reads ``IGG_*`` env vars once at `init_global_grid`
+(`/root/reference/src/init_global_grid.jl:51-68`) as the deploy-time
+configuration tier below the kwargs tier.  Its specific knobs
+(``IGG_CUDAAWARE_MPI[_DIMX/Y/Z]``, ``IGG_ROCMAWARE_MPI*``,
+``IGG_LOOPVECTORIZATION*``) toggle GPU-direct MPI transport and CPU
+vectorization per dimension — both N/A on TPU, where `collective_permute`
+always moves HBM→HBM over ICI and packing is compiled (SURVEY.md §2.3).
+
+The *mechanism* carries over with the TPU-meaningful knobs:
+
+========================  ====================================================
+``IGG_DEVICE_TYPE``       default ``device_type`` (``auto|tpu|cpu|gpu``)
+``IGG_QUIET``             nonzero suppresses the rank-0 banner
+``IGG_REORDER``           default mesh reorder flag (ICI-torus alignment)
+``IGG_OVERLAP``           default overlap in every dimension (reference
+                          kwarg ``overlapx/y/z`` default 2)
+========================  ====================================================
+
+Explicit `init_global_grid` kwargs always win over env values; env values win
+over built-in defaults — the reference's precedence.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _int_env(name: str) -> int | None:
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return None
+    try:
+        return int(val)
+    except ValueError:
+        raise ValueError(f"Environment variable {name} must be an integer, got {val!r}.")
+
+
+def env_config() -> dict:
+    """Read the ``IGG_*`` environment tier (once per init, like the reference)."""
+    cfg: dict = {}
+    device_type = os.environ.get("IGG_DEVICE_TYPE")
+    if device_type:
+        cfg["device_type"] = device_type
+    quiet = _int_env("IGG_QUIET")
+    if quiet is not None:
+        cfg["quiet"] = quiet > 0
+    reorder = _int_env("IGG_REORDER")
+    if reorder is not None:
+        cfg["reorder"] = reorder
+    overlap = _int_env("IGG_OVERLAP")
+    if overlap is not None:
+        cfg["overlap"] = overlap
+    return cfg

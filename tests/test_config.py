@@ -1,0 +1,64 @@
+"""Env-var configuration tier (reference: src/init_global_grid.jl:51-68)."""
+
+import os
+
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils.config import env_config
+
+
+@pytest.fixture
+def clean_env():
+    saved = {k: os.environ.pop(k) for k in list(os.environ) if k.startswith("IGG_")}
+    yield
+    for k in list(os.environ):
+        if k.startswith("IGG_"):
+            del os.environ[k]
+    os.environ.update(saved)
+
+
+def test_env_defaults_empty(clean_env):
+    assert env_config() == {}
+
+
+def test_env_values(clean_env):
+    os.environ["IGG_QUIET"] = "1"
+    os.environ["IGG_OVERLAP"] = "3"
+    os.environ["IGG_REORDER"] = "0"
+    os.environ["IGG_DEVICE_TYPE"] = "cpu"
+    cfg = env_config()
+    assert cfg == {"quiet": True, "overlap": 3, "reorder": 0, "device_type": "cpu"}
+
+
+def test_env_invalid_int(clean_env):
+    os.environ["IGG_OVERLAP"] = "two"
+    with pytest.raises(ValueError, match="IGG_OVERLAP"):
+        env_config()
+
+
+def test_env_applied_at_init(clean_env):
+    os.environ["IGG_OVERLAP"] = "3"
+    os.environ["IGG_QUIET"] = "1"
+    igg.init_global_grid(8, 8, 8)
+    gg = igg.get_global_grid()
+    assert gg.overlaps == (3, 3, 3)
+    assert gg.quiet is True
+    igg.finalize_global_grid()
+
+
+def test_kwargs_override_env(clean_env):
+    os.environ["IGG_OVERLAP"] = "3"
+    igg.init_global_grid(8, 8, 8, overlapy=4, quiet=True)
+    gg = igg.get_global_grid()
+    assert gg.overlaps == (3, 4, 3)
+    igg.finalize_global_grid()
+
+
+def test_profile_trace(tmp_path):
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    T = igg.zeros((8, 8, 8))
+    with igg.profile_trace(tmp_path / "trace"):
+        T = igg.update_halo(T)
+    assert any((tmp_path / "trace").rglob("*"))  # trace files written
+    igg.finalize_global_grid()
