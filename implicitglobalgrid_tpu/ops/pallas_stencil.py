@@ -1,0 +1,230 @@
+"""Pallas TPU kernel: temporally-blocked fused diffusion steps.
+
+The per-chip analogue of the reference's custom pack kernels
+(`/root/reference/src/update_halo.jl:599-649` exist because generic copies
+were off peak; here the generic XLA stencil is *at* the HBM streaming
+ceiling, so the remaining lever is doing MORE steps per HBM pass).  This
+kernel advances the 3-D diffusion update ``T += pad(dt*lam/Cp * lap(T), 1)``
+by ``k`` steps per HBM round trip — classic overlapped (trapezoid) tiling:
+
+* The volume is processed in (x, y) tiles of ``(bx, by)`` output cells
+  spanning all of z.  A ``k``-step tile needs ``k`` halo cells per side; the
+  y-halo is padded to ``H = 8*ceil(k/8)`` (sublane alignment) and the y-tile
+  loop is **unrolled** so every y-slice start is a compile-time constant —
+  the Mosaic toolchain in use miscompiles DMAs that slice the second-minor
+  dimension at a *dynamic* offset when the minor dimension spans multiple
+  lane tiles (>128).  The x loop stays a `fori_loop` with dynamic offsets
+  (x-slicing has no such constraint).
+* HBM traffic per simulated step falls from 3 full passes (read T, read Cp,
+  write T) to ``(2*(bx+2k)*(by+2H)/(bx*by) + 1)/k`` — e.g. ``k=4`` with
+  ``16x32`` tiles: 1.4 passes/step, >2x T_eff headroom on a bandwidth-bound
+  chip.  Temporal blocking is how T_eff legitimately *exceeds* raw copy
+  bandwidth.
+* Input DMAs are double-buffered (two tile slots, alternating per tile) and
+  the k-step ping-pong runs between the input slot and one scratch tile, so
+  the working set is 5 tiles of VMEM; the out-DMA source is the input slot
+  (``k`` even), whose reuse is fenced by waiting the previous out-DMA before
+  prefetching into it.
+* Each inner step updates only the tile interior and freezes the tile's
+  border ring.  Tiles at the global faces are clamped to the array, so the
+  frozen ring IS the physical boundary (correct frozen-boundary semantics);
+  for interior tiles the stale ring never reaches the output cells (validity
+  shrinks one ring per step; output offsets inside the tile are >= k).
+
+``fused_diffusion_steps(T, Cp, k)`` equals ``k`` applications of the model's
+single-step update bit-for-bit (asserted in `tests/test_pallas_stencil.py`).
+
+Multi-device note: between halo exchanges only ``k=1`` is valid with the
+standard ``overlap=2`` grids (one fresh plane per side); ``k>1`` between
+exchanges requires ``overlap >= 2k`` halos.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+
+def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
+                          *, bx: int = 16, by: int = 32):
+    """Advance ``k`` (even) diffusion steps in one HBM pass.
+
+    ``cx = dt*lam/dx^2`` (likewise ``cy``, ``cz``); ``(bx, by)`` = output
+    tile: ``bx`` divides ``T.shape[0]``; ``by`` divides ``T.shape[1]``, is a
+    multiple of 8, and yields an even tile count per row; the haloed tile
+    must fit inside the array.
+    """
+    n0, n1, n2 = T.shape
+    if k < 2 or k % 2 != 0 or k > 6:
+        raise ValueError(
+            f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "
+            "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
+            "corrupt tile-corner cells on this toolchain)."
+        )
+    if n2 > 256:
+        raise ValueError(
+            f"minor dimension {n2} > 256 unsupported (Mosaic miscompiles "
+            ">2-lane-tile tiled DMAs on this toolchain); fall back to the XLA path"
+        )
+    if n0 % bx != 0 or n1 % by != 0:
+        raise ValueError(f"tile ({bx},{by}) does not divide volume ({n0},{n1})")
+    if by % 8 != 0 or n1 % 8 != 0:
+        raise ValueError("by and the y-size must be multiples of 8 (DMA alignment)")
+    H = 8 * math.ceil(k / 8)
+    if bx + 2 * k > n0 or by + 2 * H > n1:
+        raise ValueError(f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k")
+    ncy = n1 // by
+    if ncy < 2 or ncy % 2 != 0:
+        raise ValueError(f"need an even number >= 2 of y-tiles (got {ncy}); adjust by")
+    if T.dtype != Cp.dtype:
+        raise ValueError("T and Cp must share a dtype")
+    return _build(n0, n1, n2, str(T.dtype), int(k),
+                  float(cx), float(cy), float(cz), int(bx), int(by))(T, Cp)
+
+
+@functools.lru_cache(maxsize=64)
+def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    H = 8 * math.ceil(k / 8)
+    SX, SY = bx + 2 * k, by + 2 * H
+    ncx, ncy = n0 // bx, n1 // by
+    dt_ = jnp.dtype(dtype)
+
+    def sy_of(iy: int) -> int:  # static (python) y starts/offsets
+        return max(0, min(iy * by - H, n1 - SY))
+
+    def sx_of(ix):  # dynamic (or static, for warmup/drain) x start
+        if isinstance(ix, int):
+            return max(0, min(ix * bx - k, n0 - SX))
+        return jnp.clip(ix * bx - k, 0, n0 - SX)
+
+    csum = 2.0 * (cx + cy + cz)
+
+    def make_minv(cp):
+        """1/cp, computed once per tile so the k inner steps are divide-free."""
+        return (jnp.ones((), dt_) / cp).astype(dt_)
+
+    def step_into(dst, s, minv):
+        """dst <- one diffusion step of tile value ``s``.
+
+        ``minv`` folds the frozen-ring mask and the Cp reciprocal into one
+        tile-wide multiplier, so each of the k steps is divide-free (VPU
+        divides made the naive version compute-bound).
+        """
+        lap = (
+            (s[2:, 1:-1, 1:-1] - 2 * s[1:-1, 1:-1, 1:-1] + s[:-2, 1:-1, 1:-1]) * cx
+            + (s[1:-1, 2:, 1:-1] - 2 * s[1:-1, 1:-1, 1:-1] + s[1:-1, :-2, 1:-1]) * cy
+            + (s[1:-1, 1:-1, 2:] - 2 * s[1:-1, 1:-1, 1:-1] + s[1:-1, 1:-1, :-2]) * cz
+        )
+        dst[:] = s
+        dst[1:-1, 1:-1, 1:-1] = s[1:-1, 1:-1, 1:-1] + lap * minv[1:-1, 1:-1, 1:-1]
+
+    def kernel(Tin, Cpin, Tout):
+        def body(tin, cpin, scratch, in_sems, cp_sems, out_sems):
+            # slot parity: tile (ix, iy) uses slot iy % 2 (ncy is even, so
+            # consecutive tiles alternate slots across row boundaries too).
+            def in_dma(ix, iy, slot):
+                return pltpu.make_async_copy(
+                    Tin.at[pl.ds(sx_of(ix), SX), pl.ds(sy_of(iy), SY)],
+                    tin.at[slot], in_sems.at[slot],
+                )
+
+            def cp_dma(ix, iy, slot):
+                return pltpu.make_async_copy(
+                    Cpin.at[pl.ds(sx_of(ix), SX), pl.ds(sy_of(iy), SY)],
+                    cpin.at[slot], cp_sems.at[slot],
+                )
+
+            def out_dma(ix, iy, slot):
+                ox = ix * bx - sx_of(ix)
+                oy = iy * by - sy_of(iy)  # static
+                return pltpu.make_async_copy(
+                    tin.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                    Tout.at[pl.ds(ix * bx, bx), pl.ds(iy * by, by)],
+                    out_sems.at[slot],
+                )
+
+            in_dma(0, 0, 0).start()
+            cp_dma(0, 0, 0).start()
+
+            def row(ix, _):
+                for iy in range(ncy):
+                    slot, nslot = iy % 2, (iy + 1) % 2
+                    # Next tile: (ix, iy+1), or (ix+1, 0) at the row end.
+                    nix = ix if iy < ncy - 1 else ix + 1
+                    niy = (iy + 1) % ncy
+                    # Previous tile (the one whose out-DMA used nslot).
+                    pix = ix if iy > 0 else ix - 1
+                    piy = (iy - 1) % ncy
+
+                    def fence_then_prefetch():
+                        out_dma(pix, piy, nslot).wait()
+                        in_dma(nix, niy, nslot).start()
+                        cp_dma(nix, niy, nslot).start()
+
+                    def prefetch_only():
+                        in_dma(nix, niy, nslot).start()
+                        cp_dma(nix, niy, nslot).start()
+
+                    if iy == 0:
+                        # first tile of the run has nothing to fence or is
+                        # mid-run; last row's end handled by the iy==ncy-1 arm
+                        @pl.when(ix >= 1)
+                        def _():
+                            fence_then_prefetch()
+
+                        @pl.when(ix == 0)
+                        def _():
+                            prefetch_only()
+
+                    elif iy == ncy - 1:
+                        @pl.when(ix + 1 < ncx)
+                        def _():
+                            fence_then_prefetch()
+
+                    else:
+                        fence_then_prefetch()
+
+                    in_dma(ix, iy, slot).wait()
+                    cp_dma(ix, iy, slot).wait()
+                    minv = make_minv(cpin[slot])
+                    # k-step ping-pong: tin[slot] -> scratch -> tin[slot] ...
+                    # k is even, so the final state lands back in tin[slot].
+                    for j in range(k):
+                        if j % 2 == 0:
+                            step_into(scratch, tin[slot], minv)
+                        else:
+                            step_into(tin.at[slot], scratch[:], minv)
+                    out_dma(ix, iy, slot).start()
+                return 0
+
+            jax.lax.fori_loop(0, ncx, row, 0)
+            # Drain the two in-flight out-DMAs (ncy >= 2, so both exist and
+            # use distinct slots).
+            out_dma(ncx - 1, ncy - 2, (ncy - 2) % 2).wait()
+            out_dma(ncx - 1, ncy - 1, (ncy - 1) % 2).wait()
+
+        pl.run_scoped(
+            body,
+            tin=pltpu.VMEM((2, SX, SY, n2), dt_),
+            cpin=pltpu.VMEM((2, SX, SY, n2), dt_),
+            scratch=pltpu.VMEM((SX, SY, n2), dt_),
+            in_sems=pltpu.SemaphoreType.DMA((2,)),
+            cp_sems=pltpu.SemaphoreType.DMA((2,)),
+            out_sems=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n0, n1, n2), dt_),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+    )
+    return jax.jit(call)
