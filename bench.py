@@ -1,23 +1,36 @@
 """Benchmark: 3-D heat diffusion effective memory throughput (T_eff) per chip.
 
-Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline", "extras"}``.
 
 Thin wrapper over `benchmarks/run.py` (the full harness — weak scaling,
 acoustic, porous configs live there); this entry point runs the headline
-config and adds the baseline ratio.
+config on the production-default XLA path and adds the baseline ratio.
+``extras`` records the remaining BASELINE.json configs (the temporally-blocked
+Pallas kernel `implicitglobalgrid_tpu/ops/pallas_stencil.py` with k=6 steps
+per HBM pass — it ties the XLA path at this config on v5e —, the
+comm/compute-overlap variant, acoustic, porous) so every promised config has
+a round artifact.
 
 T_eff follows the reference community's convention (ParallelStencil/IGG
 papers): the diffusion step *must* stream temperature once in and once out per
 iteration, so ``A_eff = 2 * nx*ny*nz * sizeof(dtype)`` and
 ``T_eff = A_eff / t_it``.  This is a lower bound on achieved HBM traffic
-(reads of Cp and the halo exchange are free on top), making the number
-directly comparable across machines and implementations.
+(reads of Cp and the halo exchange are free on top) — and it is exactly why
+temporal blocking can push T_eff *above* raw copy bandwidth: k fused steps
+read/write HBM roughly once, so the per-step effective traffic exceeds the
+streaming bound.
 
 Baseline: the reference publishes 510^3 on 8x P100 = local 256^3/GPU at 17.4
 ms/step for the broadcast version (100k steps / 29 min, `README.md:159-163`
 of the reference) => T_eff = 2*256^3*8 B / 17.4 ms = 15.4 GB/s, and states
 the optimized kernel version is ">10x faster" (`README.md:163`) => 154 GB/s
-per P100.  ``vs_baseline`` is measured T_eff / 154 GB/s.
+per P100.  ``vs_baseline`` is measured T_eff / 154 GB/s.  Two caveats bias
+this comparison and are accepted as-is: (a) the reference's 29-minute figure
+*includes in-situ visualization*, so 17.4 ms/step overstates the baseline's
+pure-solver cost (ratio biased in our favor); (b) the baseline ran Float64
+while this bench runs TPU-native Float32 — under the byte-counting T_eff
+convention an f32 step moves half the bytes of an f64 step, so equal GB/s
+does not mean equal steps/s.
 
 Run on the default backend (one real TPU chip under the driver; any JAX
 backend works).  Local grid 256^3 Float32 — the same per-chip problem as the
@@ -39,7 +52,53 @@ _spec.loader.exec_module(_bench)
 
 
 def main():
-    rec = _bench.bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", emit=False)
+    # Headline: the production-default XLA path (same metric name as round 1
+    # for comparability).  The Pallas temporally-blocked kernel ties it at
+    # f32 256^3 on v5e (compute-bound from halo-recompute vs XLA
+    # memory-bound) and is recorded in extras.
+    rec = _bench.bench_diffusion(n=256, chunk=24, reps=6, dtype="float32", emit=False)
+    extras = {}
+
+    def _extra(name, fn):
+        # Per-config isolation: one failing extra (e.g. the Pallas kernel on
+        # a non-TPU backend) must not discard the remaining configs.
+        try:
+            extras[name] = fn()
+        except Exception as e:
+            extras[name] = {"error": f"{type(e).__name__}: {e}"}
+
+    def _fused():
+        r = _bench.bench_diffusion(
+            n=256, chunk=24, reps=6, dtype="float32", emit=False, fused_k=6
+        )
+        return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
+
+    def _overlap():
+        r = _bench.bench_diffusion(
+            n=256, chunk=24, reps=6, dtype="float32", emit=False, hide_comm=True
+        )
+        return {
+            "teff": r["value"],
+            "t_it_ms": r["t_it_ms"],
+            "note": "1 chip: no neighbors, delta vs plain is scheduling noise",
+        }
+
+    def _acoustic():
+        r = _bench.bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", emit=False)
+        return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
+
+    def _porous():
+        r = _bench.bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", emit=False)
+        return {
+            "teff": r["value"],
+            "t_pt_ms": r.get("t_pt_ms"),
+            "note": "128^3 state largely VMEM-resident on v5e; T_eff exceeds HBM stream",
+        }
+
+    _extra("diffusion_pallas_fused6", _fused)
+    _extra("diffusion_xla_overlap", _overlap)
+    _extra("acoustic", _acoustic)
+    _extra("porous_pt", _porous)
     print(
         json.dumps(
             {
@@ -47,6 +106,7 @@ def main():
                 "value": rec["value"],
                 "unit": "GB/s/chip",
                 "vs_baseline": round(rec["value"] / BASELINE_TEFF_GBS, 3),
+                "extras": extras,
             }
         )
     )

@@ -34,22 +34,57 @@ def _sync(state):
 
     jax.block_until_ready(state)
     leaf = state[0] if isinstance(state, (tuple, list)) else state
-    # Fetch ONE element of the process-local shard: block_until_ready alone
-    # can lie on tunneled backends, fetching the global array would fail on
-    # multi-host (non-addressable) meshes, and fetching the whole shard would
-    # put MBs of transfer inside the timed region.
+    # Fetch ONE element of the process-local shard.  This is the only sync
+    # proven honest on the tunneled benchmark backend: `block_until_ready`
+    # (plain or via a dependent scalar) returns before the compute chain
+    # finishes there.  The fetch costs a full tunnel round trip (~50-90 ms
+    # measured), which `_time_steps` cancels with two-point timing.
     shard = leaf.addressable_shards[0].data
     float(shard[(0,) * shard.ndim])
 
 
 def _time_steps(step, state, chunk: int, reps: int):
+    """Per-step time by two-point window timing, min over ``reps``.
+
+    Each rep times a window of ONE ``step`` call (``chunk`` fused steps) and a
+    window of TWO calls, both ending in the same `_sync`; their difference is
+    exactly ``chunk`` steps with the sync round trip and any fixed dispatch
+    overhead cancelled.  The minimum over reps filters the shared tunnel's
+    run-to-run throughput drift (up to ~2x observed) — the fastest window
+    pair is the honest estimate of achievable hardware speed.
+    """
     state = step(*state)  # compile + warmup
     _sync(state)
+    # Rough per-call time (RTT-inflated) sizes the windows: the base window
+    # targets ~0.4 s of real work so the constant overheads being cancelled
+    # are small relative to what is measured.
     t0 = time.perf_counter()
-    for _ in range(reps):
-        state = step(*state)
+    state = step(*state)
     _sync(state)
-    return (time.perf_counter() - t0) / (reps * chunk), state
+    t_call = time.perf_counter() - t0
+    K = max(1, int(round(0.4 / max(t_call, 1e-4))))
+    best1 = best2 = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(K):
+            state = step(*state)
+        _sync(state)
+        best1 = min(best1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(2 * K):
+            state = step(*state)
+        _sync(state)
+        best2 = min(best2, time.perf_counter() - t0)
+    t_it = (best2 - best1) / (K * chunk)
+    # The 2K window is an upper bound on 2K*chunk*t_it plus at most one sync
+    # round trip (~0.05-0.09 s measured): clamp the difference estimate into
+    # that physically possible band so a drift-lucky window pair cannot
+    # report impossible speeds.
+    rtt_max = 0.12
+    lo = max((best2 - rtt_max) / (2 * K * chunk), 1e-9)  # keep t_it positive
+    hi = best2 / (2 * K * chunk)
+    t_it = min(max(t_it, lo), hi)
+    return t_it, state
 
 
 def _emit(name, teff, t_it, extra=None, emit=True):
@@ -67,7 +102,15 @@ def _emit(name, teff, t_it, extra=None, emit=True):
 
 
 def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
-                    devices=None, emit=True):
+                    devices=None, emit=True, fused_k=None):
+    """Benchmarks run with ``donate=False``: buffer donation costs ~2x on the
+    tunneled single-chip backend used for the round measurements (measured:
+    165 -> 84 GB/s at 256^3 f32; identical HLO, runtime-side penalty), and
+    T_eff measures streaming, not allocation.
+
+    ``fused_k``: use the temporally-blocked Pallas kernel (k steps per HBM
+    pass) — the lever that takes T_eff past the raw streaming bound.
+    """
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -79,13 +122,15 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices,
     )
-    step = diffusion3d.make_multi_step(params, chunk)
+    step = diffusion3d.make_multi_step(params, chunk, donate=False, fused_k=fused_k)
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     nbytes = 2 * n**3 * jax.numpy.dtype(dtype).itemsize
     return _emit(
-        f"diffusion3d_{n}_{dtype}" + ("_overlap" if hide_comm else ""),
+        f"diffusion3d_{n}_{dtype}"
+        + ("_overlap" if hide_comm else "")
+        + (f"_fused{fused_k}" if fused_k else ""),
         nbytes / t_it / 1e9,
         t_it,
         {"dims": list(gg.dims), "nprocs": gg.nprocs},
@@ -93,7 +138,8 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
     )
 
 
-def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None):
+def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None,
+                   emit=True):
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -105,7 +151,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices,
     )
-    step = acoustic3d.make_multi_step(params, chunk)
+    step = acoustic3d.make_multi_step(params, chunk, donate=False)
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
@@ -115,10 +161,11 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         nbytes / t_it / 1e9,
         t_it,
         {"dims": list(gg.dims), "nprocs": gg.nprocs},
+        emit=emit,
     )
 
 
-def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None):
+def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None, emit=True):
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -129,7 +176,7 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None):
     state, params = pc.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), npt=npt, quiet=True, devices=devices
     )
-    step = pc.make_step(params)
+    step = pc.make_step(params, donate=False)
 
     def multi(*s):
         for _ in range(chunk):
@@ -147,6 +194,7 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None):
         nbytes / t_pt / 1e9,
         t_step,
         {"dims": list(gg.dims), "nprocs": gg.nprocs, "t_pt_ms": round(t_pt * 1e3, 4)},
+        emit=emit,
     )
 
 
@@ -199,10 +247,12 @@ def main():
     p.add_argument("--dtype", default="float32")
     p.add_argument("--hide-comm", action="store_true")
     p.add_argument("--npt", type=int, default=10)
+    p.add_argument("--fused-k", type=int, default=None,
+                   help="temporally-blocked Pallas kernel: k steps per HBM pass")
     a = p.parse_args()
     kw = dict(chunk=a.chunk, reps=a.reps, dtype=a.dtype)
     if a.what in ("diffusion", "all"):
-        bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, **kw)
+        bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k, **kw)
     if a.what in ("acoustic", "all"):
         bench_acoustic(n=a.n or 192, hide_comm=a.hide_comm, **kw)
     if a.what in ("porous", "all"):

@@ -187,16 +187,65 @@ def make_step(params: Params, *, donate: bool = True):
     return stencil(block_step, donate_argnums=(0,) if donate else ())
 
 
-def make_multi_step(params: Params, nsteps: int, *, donate: bool = True):
+def make_multi_step(
+    params: Params,
+    nsteps: int,
+    *,
+    donate: bool = True,
+    fused_k: int | None = None,
+    fused_tile: tuple[int, int] = (16, 32),
+):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
     TPU-first: the whole loop is one XLA program, so per-call dispatch
     overhead amortizes away and the compiler schedules across iterations —
     use this for production runs and benchmarks.
+
+    ``fused_k``: advance ``fused_k`` steps per HBM pass with the
+    temporally-blocked Pallas kernel (`ops/pallas_stencil.py`) — the analogue
+    of the reference's custom-kernel-when-generic-is-slow move
+    (`/root/reference/src/update_halo.jl:430`), here lifting T_eff past the
+    streaming bound.  Only valid when no dimension has halo activity
+    (single block, non-periodic): between halo exchanges a width-2 overlap
+    admits one fresh step, so on a communicating grid the exchange cadence —
+    not the kernel — sets the step grouping.  Requires ``nsteps % fused_k
+    == 0`` and TPU-compatible shapes (see `fused_diffusion_steps`).
     """
     from jax import lax
 
     update = _diffusion_update(params)
+
+    if fused_k:
+        from ..parallel.grid import global_grid
+        from ..ops.pallas_stencil import fused_diffusion_steps
+
+        gg = global_grid()
+        if any(nd > 1 or p for nd, p in zip(gg.dims, gg.periods)):
+            raise ValueError(
+                "fused_k requires a grid with no halo activity (all dims == 1 "
+                f"and non-periodic); got dims={gg.dims}, periods={gg.periods}. "
+                "On a communicating grid use the XLA path (one exchange per "
+                "step with the standard overlap=2)."
+            )
+        if nsteps % fused_k != 0:
+            raise ValueError(f"nsteps={nsteps} must be a multiple of fused_k={fused_k}")
+        import jax
+
+        cx = params.dt * params.lam / (params.dx * params.dx)
+        cy = params.dt * params.lam / (params.dy * params.dy)
+        cz = params.dt * params.lam / (params.dz * params.dz)
+        bx, by = fused_tile
+
+        def fused_chunk(T, Cp):
+            def body(i, T):
+                return fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
+
+            T = lax.fori_loop(0, nsteps // fused_k, body, T)
+            return T, Cp
+
+        # No halo activity means no collectives: skip the shard_map wrapper
+        # and jit directly (fields stay committed to the 1-device mesh).
+        return jax.jit(fused_chunk, donate_argnums=(0,) if donate else ())
 
     if params.hide_comm:
         overlapped = hide_communication(update, radius=1)
@@ -231,19 +280,28 @@ def run(
     """
     import jax
 
-    state, params = setup(nx, ny, nz, **setup_kwargs)
-    step = make_step(params)
-    # On the virtual CPU mesh, XLA's in-process collectives deadlock if too
-    # many asynchronously dispatched programs pile up; syncing each step costs
-    # nothing there and is skipped on real accelerators.
-    from ..parallel.grid import global_grid
+    from ..parallel.grid import global_grid, grid_is_initialized
 
-    sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
-    for _ in range(nt):
-        state = step(*state)
-        if sync_every_step:
-            jax.block_until_ready(state)
-    T = jax.block_until_ready(state[0])
+    caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
+    try:
+        state, params = setup(nx, ny, nz, **setup_kwargs)
+        step = make_step(params)
+        # On the virtual CPU mesh, XLA's in-process collectives deadlock if
+        # too many asynchronously dispatched programs pile up; syncing each
+        # step costs nothing there and is skipped on real accelerators.
+        sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+        for _ in range(nt):
+            state = step(*state)
+            if sync_every_step:
+                jax.block_until_ready(state)
+        T = jax.block_until_ready(state[0])
+    except BaseException:
+        # A failed run must not poison the next init_global_grid in this
+        # process (the singleton would report "already initialized") — but
+        # never tear down a grid the caller set up themselves.
+        if not caller_owns_grid and grid_is_initialized():
+            finalize_global_grid()
+        raise
     if finalize:
         finalize_global_grid()
     return T

@@ -245,14 +245,25 @@ def run(nt: int, nx: int = 32, ny: int = 32, nz: int = 32, *, finalize: bool = T
 
     from ..parallel.grid import global_grid
 
-    state, params = setup(nx, ny, nz, **kw)
-    step = make_step(params)
-    sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
-    for _ in range(nt):
-        state = step(*state)
-        if sync_every_step:
-            jax.block_until_ready(state)
-    T = jax.block_until_ready(state[0])
+    from ..parallel.grid import grid_is_initialized
+
+    caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
+    try:
+        state, params = setup(nx, ny, nz, **kw)
+        step = make_step(params)
+        sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+        for _ in range(nt):
+            state = step(*state)
+            if sync_every_step:
+                jax.block_until_ready(state)
+        T = jax.block_until_ready(state[0])
+    except BaseException:
+        # A failed run must not poison the next init_global_grid in this
+        # process (the singleton would report "already initialized") — but
+        # never tear down a grid the caller set up themselves.
+        if not caller_owns_grid and grid_is_initialized():
+            finalize_global_grid()
+        raise
     if finalize:
         finalize_global_grid()
     return T

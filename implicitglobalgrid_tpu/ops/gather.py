@@ -32,11 +32,24 @@ def gather(A, A_global=None, *, root: int = 0):
     other processes.  If ``A_global`` (a numpy array of matching size and
     dtype) is given, it is filled in place on the root and ``None`` is
     returned — the reference's ``gather!(A, A_global)`` signature.
+
+    Collective: on a multi-process runtime EVERY process must make this call
+    (non-roots pass ``A_global=None``), exactly like the reference where
+    non-root ranks send (`/root/reference/src/gather.jl:33-36`); a root-only
+    call deadlocks in the underlying all-gather.
     """
     import jax
 
     _grid.check_initialized()
     gg = _grid.global_grid()
+    if not (0 <= root < jax.process_count()):
+        # Reference tests gather with non-default roots
+        # (`/root/reference/test/test_gather.jl:126-137`); an out-of-range
+        # root would silently return None everywhere, so fail loudly.
+        raise ValueError(
+            f"root must be a valid process index in [0, {jax.process_count()}); "
+            f"got {root}."
+        )
 
     if isinstance(A, jax.Array) and not A.is_fully_addressable:
         from jax.experimental import multihost_utils

@@ -231,11 +231,18 @@ def _global_update_fn(gg, shapes_dtypes):
     if fn is not None:
         return fn
     ndims_per_field = tuple(len(s) for s, _ in shapes_dtypes)
-    specs = tuple(P(*AXIS_NAMES[:nd]) for nd in ndims_per_field)
 
     def exchange(*fields):
         return _update_halo_local(fields, gg)
 
+    if gg.nprocs == 1:
+        # 1-device grid: only self-neighbor local copies remain (no ppermute,
+        # no axis environment) — plain jit avoids the SPMD execution path.
+        fn = jax.jit(exchange, donate_argnums=tuple(range(len(ndims_per_field))))
+        _jit_cache[key] = fn
+        return fn
+
+    specs = tuple(P(*AXIS_NAMES[:nd]) for nd in ndims_per_field)
     mapped = jax.shard_map(
         exchange, mesh=gg.mesh, in_specs=specs, out_specs=specs, check_vma=False
     )

@@ -97,6 +97,19 @@ class _Stencil:
     def _build(self, gg, args, treedef):
         import jax
 
+        if gg.nprocs == 1:
+            # Degenerate 1-device grid: shard_map adds nothing semantically
+            # (every mesh axis has size 1) but routes execution through the
+            # SPMD path, which measurably caps throughput on some runtimes.
+            # Plain jit — unless the function really uses mesh axis names
+            # (e.g. a custom psum), detected with a cheap abstract trace.
+            try:
+                jax.eval_shape(self._fn, *args)
+            except Exception:
+                pass  # needs the axis environment: fall through to shard_map
+            else:
+                return jax.jit(self._fn, donate_argnums=self._donate)
+
         if self._in_specs is not None:
             in_specs = self._in_specs
         else:

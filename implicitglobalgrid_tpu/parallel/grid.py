@@ -61,6 +61,10 @@ class GlobalGrid:
     quiet: bool
     # monotonically increasing across init/finalize cycles; keys jit caches
     epoch: int = 0
+    # True iff init_global_grid brought up the distributed runtime itself —
+    # the reference's `global_grid().init_MPI` used to guard `MPI.Finalize`
+    # (`/root/reference/src/finalize_global_grid.jl:19-23`).
+    owns_distributed: bool = False
 
     def replace(self, **kw) -> "GlobalGrid":
         return dataclasses.replace(self, **kw)
@@ -118,6 +122,7 @@ def init_global_grid(
     devices=None,
     device_type: str | None = None,
     init_distributed: bool = False,
+    distributed_kwargs: dict | None = None,
     select_device: bool = True,
     quiet: bool | None = None,
 ):
@@ -155,12 +160,18 @@ def init_global_grid(
     reorder = env.get("reorder", 1) if reorder is None else reorder
     device_type = env.get("device_type", DEVICE_TYPE_AUTO) if device_type is None else device_type
     quiet = env.get("quiet", False) if quiet is None else quiet
+    owns_distributed = False
     if init_distributed:
         # The reference's `init_MPI=true` analogue: bring up the multi-host
         # runtime before touching devices (src/init_global_grid.jl:78-83).
+        # ``distributed_kwargs`` (coordinator_address, num_processes,
+        # process_id, ...) pass through for manual cluster setups; on Cloud
+        # TPU pods they auto-detect.
         from . import distributed as _distributed
 
-        _distributed.init_distributed()
+        if not _distributed.is_distributed_initialized():
+            _distributed.init_distributed(**(distributed_kwargs or {}))
+            owns_distributed = True
     nxyz = [int(nx), int(ny), int(nz)]
     dims = [int(dimx), int(dimy), int(dimz)]
     periods = [int(periodx), int(periody), int(periodz)]
@@ -229,6 +240,7 @@ def init_global_grid(
         device_type=device_type,
         quiet=bool(quiet),
         epoch=_epoch,
+        owns_distributed=owns_distributed,
     )
     set_global_grid(gg)
     if not quiet and jax.process_index() == 0:
@@ -242,15 +254,22 @@ def init_global_grid(
     return me, dims, nprocs, coords, mesh
 
 
-def finalize_global_grid() -> None:
+def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     """Tear down the grid singleton (reference: src/finalize_global_grid.jl:15-27).
 
     There are no MPI handles, pinned host buffers or persistent streams to
     free on TPU — communication state lives inside compiled XLA executables —
     so finalization drops the singleton and the grid-keyed jit caches.
+
+    If `init_global_grid(init_distributed=True)` brought up the multi-host
+    runtime, it is shut down here too — the reference's guarded
+    ``MPI.Finalize`` (`/root/reference/src/finalize_global_grid.jl:19-23`).
+    Pass ``finalize_distributed=False`` (the reference's ``finalize_MPI=false``)
+    to keep the runtime alive, e.g. to re-init another grid in this process.
     """
     global _barrier_fn
     check_initialized()
+    owns_distributed = _global_grid.owns_distributed
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
 
@@ -258,6 +277,10 @@ def finalize_global_grid() -> None:
     _stencil._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
+    if finalize_distributed and owns_distributed:
+        from . import distributed as _distributed
+
+        _distributed.shutdown_distributed()
 
 
 def select_device():

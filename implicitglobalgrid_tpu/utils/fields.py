@@ -60,6 +60,18 @@ def full(local_shape, fill_value, dtype=None):
     gg = _grid.global_grid()
     local_shape = (local_shape,) if np.ndim(local_shape) == 0 else tuple(local_shape)
     shape = _global_shape(local_shape, gg)
+    if gg.nprocs == 1:
+        # Degenerate 1-device grid: a mesh sharding is semantically inert but
+        # routes later computations through the SPMD executable path (slower
+        # on some runtimes) — commit to the grid's device without it
+        # (measured equal to plain placement, and it honors a non-default
+        # ``devices=[...]`` choice).
+        from jax.sharding import SingleDeviceSharding
+
+        return jax.jit(
+            lambda: jnp.full(shape, fill_value, dtype=dtype),
+            out_shardings=SingleDeviceSharding(gg.mesh.devices.flat[0]),
+        )()
     sharding = _sharding(len(shape), gg)
     return jax.jit(
         lambda: jnp.full(shape, fill_value, dtype=dtype), out_shardings=sharding
@@ -95,6 +107,15 @@ def from_block_fn(fn, local_shape, dtype=None):
                 f"from_block_fn: fn returned shape {out.shape}, expected {local_shape}."
             )
         return out
+
+    if gg.nprocs == 1:
+        # All dims are 1, so no axis_index is ever taken: no shard_map, but
+        # still commit to the grid's device (see full()).
+        from jax.sharding import SingleDeviceSharding
+
+        return jax.jit(
+            per_block, out_shardings=SingleDeviceSharding(gg.mesh.devices.flat[0])
+        )()
 
     mapped = jax.shard_map(
         per_block,
@@ -167,6 +188,13 @@ def block_slice(A, slices):
         if out.ndim != nd:
             raise ValueError("block_slice: slices must preserve the number of dimensions.")
         return out
+
+    if gg.nprocs == 1:
+        from jax.sharding import SingleDeviceSharding
+
+        return jax.jit(
+            per_block, out_shardings=SingleDeviceSharding(gg.mesh.devices.flat[0])
+        )(A)
 
     spec = P(*AXIS_NAMES[:nd])
     mapped = jax.shard_map(

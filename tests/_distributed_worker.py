@@ -1,0 +1,96 @@
+"""Worker process for the 2-process `jax.distributed` test (not a pytest file).
+
+Spawned by `tests/test_distributed.py`: 2 processes x 4 virtual CPU devices
+each = the same 8-device mesh the rest of the suite uses, but with a real
+process boundary through it — the TPU translation of the reference running
+its suite under ``mpiexec -n N`` (`/root/reference/test/runtests.jl:8-31`).
+
+Covers the paths no single-process test can reach:
+`parallel/distributed.py` (init via `init_global_grid(init_distributed=True)`),
+multi-host ``me``/``coords`` derivation (`parallel/grid.py`), `gather`'s
+`process_allgather` branch with a non-default root
+(`/root/reference/test/test_gather.jl:126-137` analogue), and the
+finalize-shuts-down-the-runtime lifecycle
+(`/root/reference/src/finalize_global_grid.jl:19-23` analogue).
+"""
+
+import faulthandler
+import sys
+
+# Watchdog below the parent's 240 s kill: a deadlock (e.g. a collective not
+# entered by all processes) dumps both workers' stacks into the logs the
+# parent shows on failure, instead of dying silently.
+faulthandler.dump_traceback_later(180, exit=True)
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+out_path = sys.argv[4]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import diffusion3d
+from implicitglobalgrid_tpu.parallel import distributed as dist
+
+NX = 8
+NSTEPS = 3
+ROOT = 1  # non-default root: reference test_gather.jl:126-137
+
+me, dims, nprocs, coords, mesh = igg.init_global_grid(
+    NX,
+    NX,
+    NX,
+    quiet=(pid != 0),
+    init_distributed=True,
+    distributed_kwargs=dict(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    ),
+)
+assert dist.is_distributed_initialized()
+assert jax.process_count() == nproc, jax.process_count()
+assert nprocs == 8, nprocs  # 2 processes x 4 devices
+assert igg.get_global_grid().owns_distributed
+
+# me/coords = the block of this process's FIRST local device; with 4 local
+# devices per process the two processes must disagree.
+assert 0 <= me < nprocs
+assert coords == tuple(
+    int(c) for c in np.argwhere(mesh.devices == jax.local_devices()[0])[0]
+)
+
+state, params = diffusion3d.setup(NX, NX, NX, init_grid=False)
+step = diffusion3d.make_step(params)
+for _ in range(NSTEPS):
+    state = jax.block_until_ready(step(*state))
+
+T = diffusion3d.temperature(state)
+assert not T.is_fully_addressable  # the process_allgather branch, gather.py
+
+got = igg.gather(T, root=ROOT)
+if jax.process_index() == ROOT:
+    assert got is not None
+    np.save(out_path, got)
+else:
+    assert got is None
+
+# Also exercise the fill-in-place signature.  gather is a collective: every
+# process must make the call (root passes the output buffer, others None).
+buf = np.zeros_like(got) if jax.process_index() == ROOT else None
+assert igg.gather(T, buf, root=ROOT) is None
+if jax.process_index() == ROOT:
+    assert np.array_equal(buf, got)
+
+igg.finalize_global_grid()
+assert not igg.grid_is_initialized()
+assert not dist.is_distributed_initialized()  # finalize tore the runtime down
+print(f"WORKER {pid} OK", flush=True)

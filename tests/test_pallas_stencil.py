@@ -1,0 +1,120 @@
+"""Tests for the temporally-blocked Pallas diffusion kernel.
+
+The suite runs on the 8-virtual-CPU-device mesh (conftest), so the TPU
+kernel executes under `pltpu.force_tpu_interpret_mode()` — the interpreter
+implements the DMA/semaphore semantics, which is exactly what the kernel's
+double-buffering logic needs validated.  Compiled-mode numbers come from
+`bench.py` on the real chip (same code path minus the interpreter flag).
+
+Oracle: ``fused_diffusion_steps(T, Cp, k)`` vs ``k`` applications of the
+model's `_diffusion_update` — equal to a few float32 ULPs in the interior
+(the two paths fold constants differently, see the module docstring), and
+bit-exact on the frozen boundary ring.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from implicitglobalgrid_tpu.models.diffusion3d import Params, _diffusion_update
+from implicitglobalgrid_tpu.ops.pallas_stencil import fused_diffusion_steps
+
+
+def _setup(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    T = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    Cp = jnp.asarray(1.0 + rng.random(shape), jnp.float32)
+    dx = 0.1
+    dt = dx * dx / 8.1
+    params = Params(dx=dx, dy=dx, dz=dx, dt=dt, dtype=jnp.float32)
+    c = float(dt / (dx * dx))
+    return T, Cp, params, c
+
+
+def _fused_interpret(T, Cp, k, c, **kw):
+    from jax.experimental.pallas import tpu as pltpu
+
+    with pltpu.force_tpu_interpret_mode():
+        return fused_diffusion_steps(T, Cp, k, c, c, c, **kw)
+
+
+@pytest.mark.parametrize(
+    "k,shape,tile",
+    [
+        (2, (16, 32, 128), dict(bx=8, by=16)),
+        (4, (16, 32, 128), dict(bx=8, by=16)),
+        (6, (32, 32, 128), dict(bx=8, by=16)),
+    ],
+)
+def test_fused_matches_k_single_steps(k, shape, tile):
+    T, Cp, params, c = _setup(shape)
+    upd = jax.jit(_diffusion_update(params))
+    ref = T
+    for _ in range(k):
+        ref = upd(ref, Cp)
+    got = _fused_interpret(T, Cp, k, c, **tile)
+    ref = np.asarray(jax.block_until_ready(ref))
+    got = np.asarray(jax.block_until_ready(got))
+    # Interior: few-ULP agreement (different constant folding, same math).
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    assert float(np.max(np.abs(got - ref))) < 5e-6
+    # Frozen boundary ring: bit-exact (never touched by either path).
+    T0 = np.asarray(T)
+    for d in range(3):
+        lo = np.take(got, 0, axis=d)
+        hi = np.take(got, shape[d] - 1, axis=d)
+        assert np.array_equal(lo, np.take(T0, 0, axis=d))
+        assert np.array_equal(hi, np.take(T0, shape[d] - 1, axis=d))
+
+
+def test_default_tile_shape():
+    # The production default (bx=16, by=32) on a volume that admits it.
+    k = 2
+    T, Cp, params, c = _setup((32, 64, 128))
+    upd = jax.jit(_diffusion_update(params))
+    ref = upd(upd(T, Cp), Cp)
+    got = _fused_interpret(T, Cp, k, c)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_nonuniform_spacing_coefficients():
+    # cx != cy != cz must reach the right axes.
+    shape = (16, 32, 128)
+    rng = np.random.default_rng(1)
+    T = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    Cp = jnp.asarray(1.0 + rng.random(shape), jnp.float32)
+    dx, dy, dz = 0.1, 0.2, 0.4
+    dt = dx * dx / 8.1
+    params = Params(dx=dx, dy=dy, dz=dz, dt=dt, dtype=jnp.float32)
+    upd = jax.jit(_diffusion_update(params))
+    ref = upd(upd(T, Cp), Cp)
+    from jax.experimental.pallas import tpu as pltpu
+
+    with pltpu.force_tpu_interpret_mode():
+        got = fused_diffusion_steps(
+            T, Cp, 2,
+            float(dt / (dx * dx)), float(dt / (dy * dy)), float(dt / (dz * dz)),
+            bx=8, by=16,
+        )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_validation_errors():
+    T, Cp, params, c = _setup((16, 32, 128))
+    with pytest.raises(ValueError, match="k must be even"):
+        fused_diffusion_steps(T, Cp, 3, c, c, c)
+    with pytest.raises(ValueError, match="k must be even"):
+        fused_diffusion_steps(T, Cp, 8, c, c, c)
+    with pytest.raises(ValueError, match="does not divide"):
+        fused_diffusion_steps(T, Cp, 2, c, c, c, bx=7, by=16)
+    with pytest.raises(ValueError, match="minor dimension"):
+        big = jnp.zeros((16, 32, 512), jnp.float32)
+        fused_diffusion_steps(big, jnp.ones_like(big), 2, c, c, c, bx=8, by=16)
+    with pytest.raises(ValueError, match="share a dtype"):
+        fused_diffusion_steps(T, Cp.astype(jnp.bfloat16), 2, c, c, c, bx=8, by=16)
