@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -46,12 +47,14 @@ def _sync(state):
 def _time_steps(step, state, chunk: int, reps: int):
     """Per-step time by two-point window timing, min over ``reps``.
 
-    Each rep times a window of ONE ``step`` call (``chunk`` fused steps) and a
-    window of TWO calls, both ending in the same `_sync`; their difference is
-    exactly ``chunk`` steps with the sync round trip and any fixed dispatch
-    overhead cancelled.  The minimum over reps filters the shared tunnel's
-    run-to-run throughput drift (up to ~2x observed) — the fastest window
-    pair is the honest estimate of achievable hardware speed.
+    Each rep times a window of K chained ``step`` calls (K*chunk fused steps;
+    K sized so a window is ~0.4 s of work) and a window of 2K calls, both
+    ending in the same `_sync`; their difference is K*chunk steps' worth of
+    real work — including those calls' own (pipelined) dispatch, which a
+    production loop pays too — with the constant per-window sync round trip
+    cancelled.  The minimum over reps filters the shared tunnel's run-to-run
+    throughput drift (up to ~2x observed); the estimate is then clamped into
+    the band the 2K window physically allows (`rtt_max` below).
     """
     state = step(*state)  # compile + warmup
     _sync(state)
@@ -102,7 +105,7 @@ def _emit(name, teff, t_it, extra=None, emit=True):
 
 
 def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
-                    devices=None, emit=True, fused_k=None):
+                    devices=None, emit=True, fused_k=None, force_spmd=False):
     """Benchmarks run with ``donate=False``: buffer donation costs ~2x on the
     tunneled single-chip backend used for the round measurements (measured:
     165 -> 84 GB/s at 256^3 f32; identical HLO, runtime-side penalty), and
@@ -120,7 +123,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         igg.finalize_global_grid()
     state, params = diffusion3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
-        devices=devices,
+        devices=devices, force_spmd=force_spmd,
     )
     step = diffusion3d.make_multi_step(params, chunk, donate=False, fused_k=fused_k)
     t_it, state = _time_steps(step, state, chunk, reps)
@@ -202,7 +205,11 @@ def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False
     """Weak scaling: same local n^3 per device on growing sub-meshes.
 
     Parallel efficiency = t(1 device) / t(N devices); ~1.0 means the halo
-    exchange is fully hidden or negligible.
+    exchange is fully hidden or negligible.  All counts run ``force_spmd``
+    so the 1-device baseline goes through the same shard_map/SPMD execution
+    path as the multi-device runs — otherwise the 1-device fast path (see
+    docs/performance.md) would make the ratio conflate SPMD dispatch
+    overhead with communication cost.
     """
     import jax
 
@@ -218,7 +225,7 @@ def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False
     for c in counts:
         rec = bench_diffusion(
             n=n, chunk=chunk, reps=reps, dtype=dtype, hide_comm=hide_comm,
-            devices=devs[:c],
+            devices=devs[:c], force_spmd=True,
         )
         results[c] = rec["t_it_ms"]
     base = results[1]
@@ -266,4 +273,9 @@ def main():
 
 
 if __name__ == "__main__":
+    # Direct invocation (`python benchmarks/run.py ...`) puts benchmarks/ on
+    # sys.path, not the repo root where the package lives.
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     main()

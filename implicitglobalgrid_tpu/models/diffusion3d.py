@@ -213,13 +213,17 @@ def make_multi_step(
     """
     from jax import lax
 
-    update = _diffusion_update(params)
-
     if fused_k:
         from ..parallel.grid import global_grid
         from ..ops.pallas_stencil import fused_diffusion_steps
 
         gg = global_grid()
+        if params.hide_comm:
+            raise ValueError(
+                "fused_k and hide_comm are mutually exclusive: the fused "
+                "kernel runs only on grids with no halo activity, where "
+                "there is no communication to hide."
+            )
         if any(nd > 1 or p for nd, p in zip(gg.dims, gg.periods)):
             raise ValueError(
                 "fused_k requires a grid with no halo activity (all dims == 1 "
@@ -244,8 +248,10 @@ def make_multi_step(
             return T, Cp
 
         # No halo activity means no collectives: skip the shard_map wrapper
-        # and jit directly (fields stay committed to the 1-device mesh).
+        # and jit directly (fields are committed to the grid's single device).
         return jax.jit(fused_chunk, donate_argnums=(0,) if donate else ())
+
+    update = _diffusion_update(params)
 
     if params.hide_comm:
         overlapped = hide_communication(update, radius=1)

@@ -243,9 +243,7 @@ def run(nt: int, nx: int = 32, ny: int = 32, nz: int = 32, *, finalize: bool = T
     """End-to-end run; returns the final global-block temperature field."""
     import jax
 
-    from ..parallel.grid import global_grid
-
-    from ..parallel.grid import grid_is_initialized
+    from ..parallel.grid import global_grid, grid_is_initialized
 
     caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
     try:

@@ -235,7 +235,7 @@ def _global_update_fn(gg, shapes_dtypes):
     def exchange(*fields):
         return _update_halo_local(fields, gg)
 
-    if gg.nprocs == 1:
+    if gg.nprocs == 1 and not gg.force_spmd:
         # 1-device grid: only self-neighbor local copies remain (no ppermute,
         # no axis environment) — plain jit avoids the SPMD execution path.
         fn = jax.jit(exchange, donate_argnums=tuple(range(len(ndims_per_field))))

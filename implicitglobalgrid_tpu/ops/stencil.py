@@ -97,7 +97,7 @@ class _Stencil:
     def _build(self, gg, args, treedef):
         import jax
 
-        if gg.nprocs == 1:
+        if gg.nprocs == 1 and not gg.force_spmd:
             # Degenerate 1-device grid: shard_map adds nothing semantically
             # (every mesh axis has size 1) but routes execution through the
             # SPMD path, which measurably caps throughput on some runtimes.

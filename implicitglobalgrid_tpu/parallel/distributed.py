@@ -12,6 +12,17 @@ from __future__ import annotations
 
 import os
 
+# True while THIS module brought the distributed runtime up and it has not
+# been shut down — module-level (not per-grid) so ownership survives
+# `finalize_global_grid(finalize_distributed=False)` + re-init cycles
+# (the reference's guarded `MPI.Finalize` semantics,
+# `/root/reference/src/finalize_global_grid.jl:19-23`).
+_owns_runtime = False
+
+
+def owns_runtime() -> bool:
+    return _owns_runtime
+
 
 def init_distributed(
     coordinator_address: str | None = None,
@@ -29,6 +40,7 @@ def init_distributed(
     """
     import jax
 
+    global _owns_runtime
     if is_distributed_initialized():
         return
     jax.distributed.initialize(
@@ -37,6 +49,7 @@ def init_distributed(
         process_id=process_id,
         **kwargs,
     )
+    _owns_runtime = True
 
 
 def is_distributed_initialized() -> bool:
@@ -51,8 +64,10 @@ def shutdown_distributed() -> None:
     `/root/reference/src/finalize_global_grid.jl:19-23`)."""
     import jax
 
+    global _owns_runtime
     if is_distributed_initialized():
         jax.distributed.shutdown()
+    _owns_runtime = False
 
 
 def process_index() -> int:

@@ -61,10 +61,15 @@ class GlobalGrid:
     quiet: bool
     # monotonically increasing across init/finalize cycles; keys jit caches
     epoch: int = 0
-    # True iff init_global_grid brought up the distributed runtime itself —
-    # the reference's `global_grid().init_MPI` used to guard `MPI.Finalize`
-    # (`/root/reference/src/finalize_global_grid.jl:19-23`).
+    # Snapshot at init time of whether this library brought up the
+    # distributed runtime (see `distributed.owns_runtime`, which is the
+    # live, module-level flag `finalize_global_grid` actually consults —
+    # ownership survives `finalize_distributed=False` re-init cycles).
     owns_distributed: bool = False
+    # Route even degenerate 1-device grids through shard_map/NamedSharding
+    # (used by the weak-scaling benchmark so t(1) and t(N) measure the same
+    # execution path; see docs/performance.md on the SPMD-path cost).
+    force_spmd: bool = False
 
     def replace(self, **kw) -> "GlobalGrid":
         return dataclasses.replace(self, **kw)
@@ -125,6 +130,7 @@ def init_global_grid(
     distributed_kwargs: dict | None = None,
     select_device: bool = True,
     quiet: bool | None = None,
+    force_spmd: bool = False,
 ):
     """Initialize the Cartesian device topology, implicitly defining a global grid.
 
@@ -169,9 +175,8 @@ def init_global_grid(
         # TPU pods they auto-detect.
         from . import distributed as _distributed
 
-        if not _distributed.is_distributed_initialized():
-            _distributed.init_distributed(**(distributed_kwargs or {}))
-            owns_distributed = True
+        _distributed.init_distributed(**(distributed_kwargs or {}))
+        owns_distributed = _distributed.owns_runtime()
     nxyz = [int(nx), int(ny), int(nz)]
     dims = [int(dimx), int(dimy), int(dimz)]
     periods = [int(periodx), int(periody), int(periodz)]
@@ -241,6 +246,7 @@ def init_global_grid(
         quiet=bool(quiet),
         epoch=_epoch,
         owns_distributed=owns_distributed,
+        force_spmd=bool(force_spmd),
     )
     set_global_grid(gg)
     if not quiet and jax.process_index() == 0:
@@ -269,7 +275,6 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     """
     global _barrier_fn
     check_initialized()
-    owns_distributed = _global_grid.owns_distributed
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
 
@@ -277,10 +282,11 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     _stencil._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
-    if finalize_distributed and owns_distributed:
+    if finalize_distributed:
         from . import distributed as _distributed
 
-        _distributed.shutdown_distributed()
+        if _distributed.owns_runtime():
+            _distributed.shutdown_distributed()
 
 
 def select_device():

@@ -60,7 +60,7 @@ def full(local_shape, fill_value, dtype=None):
     gg = _grid.global_grid()
     local_shape = (local_shape,) if np.ndim(local_shape) == 0 else tuple(local_shape)
     shape = _global_shape(local_shape, gg)
-    if gg.nprocs == 1:
+    if gg.nprocs == 1 and not gg.force_spmd:
         # Degenerate 1-device grid: a mesh sharding is semantically inert but
         # routes later computations through the SPMD executable path (slower
         # on some runtimes) — commit to the grid's device without it
@@ -108,7 +108,7 @@ def from_block_fn(fn, local_shape, dtype=None):
             )
         return out
 
-    if gg.nprocs == 1:
+    if gg.nprocs == 1 and not gg.force_spmd:
         # All dims are 1, so no axis_index is ever taken: no shard_map, but
         # still commit to the grid's device (see full()).
         from jax.sharding import SingleDeviceSharding
@@ -189,7 +189,7 @@ def block_slice(A, slices):
             raise ValueError("block_slice: slices must preserve the number of dimensions.")
         return out
 
-    if gg.nprocs == 1:
+    if gg.nprocs == 1 and not gg.force_spmd:
         from jax.sharding import SingleDeviceSharding
 
         return jax.jit(
