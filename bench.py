@@ -3,13 +3,13 @@
 Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline", "extras"}``.
 
 Thin wrapper over `benchmarks/run.py` (the full harness — weak scaling,
-acoustic, porous configs live there); this entry point runs the headline
-config on the production-default XLA path and adds the baseline ratio.
-``extras`` records the remaining BASELINE.json configs (the temporally-blocked
-Pallas kernel `implicitglobalgrid_tpu/ops/pallas_stencil.py` with k=6 steps
-per HBM pass — it ties the XLA path at this config on v5e —, the
-comm/compute-overlap variant, acoustic, porous) so every promised config has
-a round artifact.
+acoustic, porous configs live there); this entry point measures the headline
+config on both production paths — the plain XLA stencil and the
+temporally-blocked Pallas kernel (`implicitglobalgrid_tpu/ops/pallas_stencil.py`,
+k=4 steps per HBM pass, 32x64 tiles tuned on v5e — ~1.4x the XLA path there)
+— and reports the faster one, with both recorded in ``extras`` alongside the
+remaining BASELINE.json configs (comm/compute-overlap variant, acoustic,
+porous) so every promised config has a round artifact.
 
 T_eff follows the reference community's convention (ParallelStencil/IGG
 papers): the diffusion step *must* stream temperature once in and once out per
@@ -52,12 +52,12 @@ _spec.loader.exec_module(_bench)
 
 
 def main():
-    # Headline: the production-default XLA path (same metric name as round 1
-    # for comparability).  The Pallas temporally-blocked kernel ties it at
-    # f32 256^3 on v5e (compute-bound from halo-recompute vs XLA
-    # memory-bound) and is recorded in extras.
+    # Headline: the faster of the two production paths at the headline config
+    # (metric name unchanged from round 1 for comparability).  The XLA path
+    # is the always-available fallback if the Pallas kernel fails on some
+    # backend.
     rec = _bench.bench_diffusion(n=256, chunk=24, reps=6, dtype="float32", emit=False)
-    extras = {}
+    extras = {"diffusion_xla": {"teff": rec["value"], "t_it_ms": rec["t_it_ms"]}}
 
     def _extra(name, fn):
         # Per-config isolation: one failing extra (e.g. the Pallas kernel on
@@ -69,7 +69,7 @@ def main():
 
     def _fused():
         r = _bench.bench_diffusion(
-            n=256, chunk=24, reps=6, dtype="float32", emit=False, fused_k=6
+            n=256, chunk=24, reps=6, dtype="float32", emit=False, fused_k=4
         )
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
@@ -95,17 +95,20 @@ def main():
             "note": "128^3 state largely VMEM-resident on v5e; T_eff exceeds HBM stream",
         }
 
-    _extra("diffusion_pallas_fused6", _fused)
+    _extra("diffusion_pallas_fused4", _fused)
     _extra("diffusion_xla_overlap", _overlap)
     _extra("acoustic", _acoustic)
     _extra("porous_pt", _porous)
+    best = rec["value"]
+    fused = extras.get("diffusion_pallas_fused4", {})
+    best = max(best, fused.get("teff", 0.0))
     print(
         json.dumps(
             {
-                "metric": rec["metric"] + "_teff",
-                "value": rec["value"],
+                "metric": "diffusion3d_256_float32_teff",
+                "value": best,
                 "unit": "GB/s/chip",
-                "vs_baseline": round(rec["value"] / BASELINE_TEFF_GBS, 3),
+                "vs_baseline": round(best / BASELINE_TEFF_GBS, 3),
                 "extras": extras,
             }
         )

@@ -193,7 +193,7 @@ def make_multi_step(
     *,
     donate: bool = True,
     fused_k: int | None = None,
-    fused_tile: tuple[int, int] = (16, 32),
+    fused_tile: tuple[int, int] = (32, 64),
 ):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
