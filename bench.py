@@ -73,6 +73,16 @@ def main():
         )
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
+    def _fused512():
+        # BASELINE config 5's per-chip problem size (512^3/chip).  The XLA
+        # path collapses past a 256 minor dim (see docs/performance.md); the
+        # fused kernel holds its throughput, so it is the production choice
+        # at this size.
+        r = _bench.bench_diffusion(
+            n=512, chunk=24, reps=4, dtype="float32", emit=False, fused_k=4
+        )
+        return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
+
     def _overlap():
         r = _bench.bench_diffusion(
             n=256, chunk=24, reps=6, dtype="float32", emit=False, hide_comm=True
@@ -96,6 +106,7 @@ def main():
         }
 
     _extra("diffusion_pallas_fused4", _fused)
+    _extra("diffusion_512_pallas_fused4", _fused512)
     _extra("diffusion_xla_overlap", _overlap)
     _extra("acoustic", _acoustic)
     _extra("porous_pt", _porous)

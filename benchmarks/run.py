@@ -59,21 +59,28 @@ def _time_steps(step, state, chunk: int, reps: int):
     by device work, not wall time of a synced call.  K targets ~1.5 s of
     estimated pure work per base window, making the residual RTT drift a
     few-percent effect on the difference.  The per-rep differences are
-    combined by median (robust to a drift spike in either window of one rep);
-    the only clamp left is the physical upper bound t_it <= 2K-window /
-    (2K*chunk) steps, which a correct difference can never exceed.
+    combined by median (robust to a drift spike in either window of one rep)
+    and clamped into the physically possible band derived from the fastest
+    2K window (see the comment at the clamp).
     """
     state = step(*state)  # compile + warmup
     _sync(state)
-    # Work-only estimate from one ~20-call window (single sync at the end, so
-    # the RTT amortizes over all calls instead of inflating one).
+    # Sync-only round trip: state is already materialized, so this times the
+    # fetch RTT alone.
+    t0 = time.perf_counter()
+    _sync(state)
+    rtt_est = time.perf_counter() - t0
+    # Work-only estimate from one ~20-call window (single sync at the end);
+    # subtracting the measured RTT keeps K honest on fast configs, where one
+    # RTT can otherwise inflate the estimate severalfold and shrink the
+    # window below the work target.
     ncal = 20
     t0 = time.perf_counter()
     for _ in range(ncal):
         state = step(*state)
     _sync(state)
-    t_call_est = (time.perf_counter() - t0) / ncal
-    K = max(4, int(round(1.5 / max(t_call_est, 1e-5))))
+    t_call_est = max((time.perf_counter() - t0 - rtt_est), 1e-4 * ncal) / ncal
+    K = max(4, int(round(1.5 / t_call_est)))
     diffs = []
     b2_min = float("inf")
     for _ in range(reps):
@@ -96,8 +103,9 @@ def _time_steps(step, state, chunk: int, reps: int):
     # cannot be below (b2_min - rtt_bound)/(2K*chunk) either, which guards
     # against a drift pattern (slow K-windows, fast 2K-windows) driving the
     # median difference toward zero and inflating the reported speed without
-    # bound.  rtt_bound is deliberately loose (>3x the worst observed RTT);
-    # with ~3 s 2K windows it caps artifact inflation at ~1.5x.
+    # bound.  rtt_bound is deliberately loose (>3x the worst observed RTT) so
+    # the lower clamp only fires on pathological drift, not on honest
+    # measurements; with ~3 s 2K windows it caps artifact inflation at ~1.5x.
     rtt_bound = 1.0
     lo = max((b2_min - rtt_bound) / (2 * K * chunk), 1e-9)
     t_it = min(max(t_it, lo), b2_min / (2 * K * chunk))

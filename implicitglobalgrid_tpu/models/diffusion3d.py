@@ -193,7 +193,7 @@ def make_multi_step(
     *,
     donate: bool = True,
     fused_k: int | None = None,
-    fused_tile: tuple[int, int] = (32, 64),
+    fused_tile: tuple[int, int] | None = None,
 ):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
@@ -238,7 +238,7 @@ def make_multi_step(
         cx = params.dt * params.lam / (params.dx * params.dx)
         cy = params.dt * params.lam / (params.dy * params.dy)
         cz = params.dt * params.lam / (params.dz * params.dz)
-        bx, by = fused_tile
+        bx, by = fused_tile if fused_tile is not None else (None, None)
 
         def fused_chunk(T, Cp):
             def body(i, T):

@@ -9,12 +9,13 @@ by ``k`` steps per HBM round trip — classic overlapped (trapezoid) tiling:
 
 * The volume is processed in (x, y) tiles of ``(bx, by)`` output cells
   spanning all of z.  A ``k``-step tile needs ``k`` halo cells per side; the
-  y-halo is padded to ``H = 8*ceil(k/8)`` (sublane alignment) and the y-tile
-  loop is **unrolled** so every y-slice start is a compile-time constant —
-  the Mosaic toolchain in use miscompiles DMAs that slice the second-minor
-  dimension at a *dynamic* offset when the minor dimension spans multiple
-  lane tiles (>128).  The x loop stays a `fori_loop` with dynamic offsets
-  (x-slicing has no such constraint).
+  y-halo is padded to ``H = 8*ceil(k/8)`` (sublane alignment) and all tiles
+  run in ONE flat `fori_loop` with *dynamic* DMA offsets, annotated with
+  `pl.multiple_of(..., 8)` so Mosaic can prove the second-minor slice starts
+  are sublane-aligned (without the hint it refuses to compile; an earlier
+  toolchain miscompiled these DMAs outright, which is why a previous
+  revision unrolled the y loop — the unroll made compile time scale as
+  tiles x tile-elements and priced out volumes past 256^3).
 * HBM traffic per simulated step falls from 3 full passes (read T, read Cp,
   write T) to ``(2*(bx+2k)*(by+2H)/(bx*by) + 1)/k`` — e.g. ``k=4`` with the
   tuned-default ``32x64`` tiles: ~1.03 passes/step, ~3x T_eff headroom on a
@@ -51,14 +52,48 @@ import functools
 import math
 
 
+#: Tile candidates for auto-selection, fastest first (tuned on v5e; smaller
+#: tiles trade halo-recompute redundancy for fitting smaller volumes).
+_TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
+
+
+def _tile_error(n0, n1, n2, k, bx, by, itemsize):
+    """The validation error a (bx, by) tile would raise, or None if valid."""
+    H = 8 * math.ceil(k / 8)
+    vmem_need = 5 * (bx + 2 * k) * (by + 2 * H) * n2 * itemsize
+    if vmem_need > 100 * 1024 * 1024:
+        return (
+            f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of VMEM "
+            "(5 haloed tiles spanning z); shrink the tile or k"
+        )
+    if n0 % bx != 0 or n1 % by != 0:
+        return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"
+    if by % 8 != 0 or n1 % 8 != 0:
+        return "by and the y-size must be multiples of 8 (DMA alignment)"
+    if bx + 2 * k > n0 or by + 2 * H > n1:
+        return f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k"
+    if n1 // by < 2:
+        return f"need >= 2 y-tiles (got {n1 // by}); shrink by"
+    return None
+
+
+def default_tile(shape, k: int, itemsize: int = 4):
+    """First tuned tile candidate valid for ``shape``, or None if none fits."""
+    n0, n1, n2 = shape
+    for bx, by in _TILE_CANDIDATES:
+        if _tile_error(n0, n1, n2, k, bx, by, itemsize) is None:
+            return (bx, by)
+    return None
+
+
 def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
-                          *, bx: int = 32, by: int = 64):
+                          *, bx: int | None = None, by: int | None = None):
     """Advance ``k`` (even) diffusion steps in one HBM pass.
 
     ``cx = dt*lam/dx^2`` (likewise ``cy``, ``cz``); ``(bx, by)`` = output
-    tile: ``bx`` divides ``T.shape[0]``; ``by`` divides ``T.shape[1]``, is a
-    multiple of 8, and yields an even tile count per row; the haloed tile
-    must fit inside the array.
+    tile: ``bx`` divides ``T.shape[0]``; ``by`` divides ``T.shape[1]`` and is
+    a multiple of 8; the haloed tile must fit inside the array.  Defaults to
+    the fastest valid `_TILE_CANDIDATES` entry for the volume.
     """
     n0, n1, n2 = T.shape
     if k < 2 or k % 2 != 0 or k > 6:
@@ -67,23 +102,30 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
             "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
             "corrupt tile-corner cells on this toolchain)."
         )
-    if n2 > 256:
+    if n2 > 1024:
+        # Bit-level agreement with the XLA path is validated on hardware up
+        # to n2=1024 (an earlier toolchain miscompiled >2-lane-tile tiled
+        # DMAs; the current one is clean, with `pl.multiple_of` alignment
+        # hints on the dynamic offsets).
         raise ValueError(
-            f"minor dimension {n2} > 256 unsupported (Mosaic miscompiles "
-            ">2-lane-tile tiled DMAs on this toolchain); fall back to the XLA path"
+            f"minor dimension {n2} > 1024 not validated on this toolchain; "
+            "fall back to the XLA path"
         )
-    if n0 % bx != 0 or n1 % by != 0:
-        raise ValueError(f"tile ({bx},{by}) does not divide volume ({n0},{n1})")
-    if by % 8 != 0 or n1 % 8 != 0:
-        raise ValueError("by and the y-size must be multiples of 8 (DMA alignment)")
-    H = 8 * math.ceil(k / 8)
-    if bx + 2 * k > n0 or by + 2 * H > n1:
-        raise ValueError(f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k")
-    ncy = n1 // by
-    if ncy < 2 or ncy % 2 != 0:
-        raise ValueError(f"need an even number >= 2 of y-tiles (got {ncy}); adjust by")
     if T.dtype != Cp.dtype:
         raise ValueError("T and Cp must share a dtype")
+    if bx is None and by is None:
+        picked = default_tile((n0, n1, n2), k, T.dtype.itemsize)
+        if picked is None:
+            raise ValueError(
+                f"no tuned tile candidate {_TILE_CANDIDATES} fits volume "
+                f"({n0},{n1},{n2}) with k={k}; pass bx/by explicitly"
+            )
+        bx, by = picked
+    elif bx is None or by is None:
+        raise ValueError("pass both bx and by, or neither")
+    err = _tile_error(n0, n1, n2, k, bx, by, T.dtype.itemsize)
+    if err is not None:
+        raise ValueError(err)
     return _build(n0, n1, n2, str(T.dtype), int(k),
                   float(cx), float(cy), float(cz), int(bx), int(by))(T, Cp)
 
@@ -100,15 +142,14 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
     ncx, ncy = n0 // bx, n1 // by
     dt_ = jnp.dtype(dtype)
 
-    def sy_of(iy: int) -> int:  # static (python) y starts/offsets
-        return max(0, min(iy * by - H, n1 - SY))
-
-    def sx_of(ix):  # dynamic (or static, for warmup/drain) x start
-        if isinstance(ix, int):
-            return max(0, min(ix * bx - k, n0 - SX))
+    def sx_of(ix):  # haloed-window x start, clamped to the array
         return jnp.clip(ix * bx - k, 0, n0 - SX)
 
-    csum = 2.0 * (cx + cy + cz)
+    def sy_of(iy):
+        # Always a multiple of 8 (by, H, and n1-SY all are), but Mosaic
+        # cannot prove that through the clip — assert it, or the dynamic
+        # second-minor DMA slice is rejected as potentially unaligned.
+        return pl.multiple_of(jnp.clip(iy * by - H, 0, n1 - SY), 8)
 
     def make_minv(cp):
         """1/cp, computed once per tile so the k inner steps are divide-free."""
@@ -130,90 +171,75 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
         dst[:] = s
         dst[1:-1, 1:-1, 1:-1] = s[1:-1, 1:-1, 1:-1] + lap * minv[1:-1, 1:-1, 1:-1]
 
+    ntiles = ncx * ncy
+
     def kernel(Tin, Cpin, Tout):
         def body(tin, cpin, scratch, in_sems, cp_sems, out_sems):
-            # slot parity: tile (ix, iy) uses slot iy % 2 (ncy is even, so
-            # consecutive tiles alternate slots across row boundaries too).
-            def in_dma(ix, iy, slot):
+            # One flat tile index t = ix*ncy + iy; slot parity alternates
+            # with t, so consecutive tiles always double-buffer.
+            def ixy(t):
+                return t // ncy, t % ncy
+
+            def in_dma(t, slot):
+                ix, iy = ixy(t)
                 return pltpu.make_async_copy(
                     Tin.at[pl.ds(sx_of(ix), SX), pl.ds(sy_of(iy), SY)],
                     tin.at[slot], in_sems.at[slot],
                 )
 
-            def cp_dma(ix, iy, slot):
+            def cp_dma(t, slot):
+                ix, iy = ixy(t)
                 return pltpu.make_async_copy(
                     Cpin.at[pl.ds(sx_of(ix), SX), pl.ds(sy_of(iy), SY)],
                     cpin.at[slot], cp_sems.at[slot],
                 )
 
-            def out_dma(ix, iy, slot):
+            def out_dma(t, slot):
+                ix, iy = ixy(t)
                 ox = ix * bx - sx_of(ix)
-                oy = iy * by - sy_of(iy)  # static
+                oy = pl.multiple_of(iy * by - sy_of(iy), 8)
                 return pltpu.make_async_copy(
                     tin.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
                     Tout.at[pl.ds(ix * bx, bx), pl.ds(iy * by, by)],
                     out_sems.at[slot],
                 )
 
-            in_dma(0, 0, 0).start()
-            cp_dma(0, 0, 0).start()
+            in_dma(0, 0).start()
+            cp_dma(0, 0).start()
 
-            def row(ix, _):
-                for iy in range(ncy):
-                    slot, nslot = iy % 2, (iy + 1) % 2
-                    # Next tile: (ix, iy+1), or (ix+1, 0) at the row end.
-                    nix = ix if iy < ncy - 1 else ix + 1
-                    niy = (iy + 1) % ncy
-                    # Previous tile (the one whose out-DMA used nslot).
-                    pix = ix if iy > 0 else ix - 1
-                    piy = (iy - 1) % ncy
+            def tile(t, _):
+                slot = jax.lax.rem(t, 2)
+                nslot = 1 - slot
 
-                    def fence_then_prefetch():
-                        out_dma(pix, piy, nslot).wait()
-                        in_dma(nix, niy, nslot).start()
-                        cp_dma(nix, niy, nslot).start()
+                @pl.when(t + 1 < ntiles)
+                def _():
+                    @pl.when(t >= 1)
+                    def _():
+                        # nslot still holds tile t-1's output; fence the
+                        # out-DMA before prefetching into it.
+                        out_dma(t - 1, nslot).wait()
 
-                    def prefetch_only():
-                        in_dma(nix, niy, nslot).start()
-                        cp_dma(nix, niy, nslot).start()
+                    in_dma(t + 1, nslot).start()
+                    cp_dma(t + 1, nslot).start()
 
-                    if iy == 0:
-                        # first tile of the run has nothing to fence or is
-                        # mid-run; last row's end handled by the iy==ncy-1 arm
-                        @pl.when(ix >= 1)
-                        def _():
-                            fence_then_prefetch()
-
-                        @pl.when(ix == 0)
-                        def _():
-                            prefetch_only()
-
-                    elif iy == ncy - 1:
-                        @pl.when(ix + 1 < ncx)
-                        def _():
-                            fence_then_prefetch()
-
+                in_dma(t, slot).wait()
+                cp_dma(t, slot).wait()
+                minv = make_minv(cpin[slot])
+                # k-step ping-pong: tin[slot] -> scratch -> tin[slot] ...
+                # k is even, so the final state lands back in tin[slot].
+                for j in range(k):
+                    if j % 2 == 0:
+                        step_into(scratch, tin[slot], minv)
                     else:
-                        fence_then_prefetch()
-
-                    in_dma(ix, iy, slot).wait()
-                    cp_dma(ix, iy, slot).wait()
-                    minv = make_minv(cpin[slot])
-                    # k-step ping-pong: tin[slot] -> scratch -> tin[slot] ...
-                    # k is even, so the final state lands back in tin[slot].
-                    for j in range(k):
-                        if j % 2 == 0:
-                            step_into(scratch, tin[slot], minv)
-                        else:
-                            step_into(tin.at[slot], scratch[:], minv)
-                    out_dma(ix, iy, slot).start()
+                        step_into(tin.at[slot], scratch[:], minv)
+                out_dma(t, slot).start()
                 return 0
 
-            jax.lax.fori_loop(0, ncx, row, 0)
-            # Drain the two in-flight out-DMAs (ncy >= 2, so both exist and
-            # use distinct slots).
-            out_dma(ncx - 1, ncy - 2, (ncy - 2) % 2).wait()
-            out_dma(ncx - 1, ncy - 1, (ncy - 1) % 2).wait()
+            jax.lax.fori_loop(0, ntiles, tile, 0)
+            # Drain the two in-flight out-DMAs (ntiles >= 2 by validation,
+            # and they use distinct slots).
+            out_dma(ntiles - 2, (ntiles - 2) % 2).wait()
+            out_dma(ntiles - 1, (ntiles - 1) % 2).wait()
 
         pl.run_scoped(
             body,

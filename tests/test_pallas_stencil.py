@@ -46,6 +46,8 @@ def _fused_interpret(T, Cp, k, c, **kw):
         (2, (16, 32, 128), dict(bx=8, by=16)),
         (4, (16, 32, 128), dict(bx=8, by=16)),
         (6, (32, 32, 128), dict(bx=8, by=16)),
+        # minor dim spanning >1 lane tile (validated on hardware to n2=1024)
+        (2, (16, 32, 384), dict(bx=8, by=16)),
     ],
 )
 def test_fused_matches_k_single_steps(k, shape, tile):
@@ -106,6 +108,27 @@ def test_nonuniform_spacing_coefficients():
     )
 
 
+def test_auto_tile_fallback():
+    # Volumes the tuned (32,64) tile does not fit fall back to smaller
+    # candidates instead of raising (the old fixed default rejected them).
+    from implicitglobalgrid_tpu.ops.pallas_stencil import default_tile
+
+    assert default_tile((64, 128, 128), 2) == (32, 64)
+    assert default_tile((96, 96, 128), 2) == (16, 32)   # 64 does not divide 96
+    assert default_tile((32, 64, 128), 2) == (16, 32)   # ncy=1 at by=64
+    assert default_tile((16, 32, 128), 2) == (8, 16)  # too small for 16x32 halos
+    assert default_tile((8, 8, 128), 2) is None
+    # End-to-end: auto-picked tile matches k XLA steps.
+    k = 2
+    T, Cp, params, c = _setup((32, 64, 128))
+    upd = jax.jit(_diffusion_update(params))
+    ref = upd(upd(T, Cp), Cp)
+    got = _fused_interpret(T, Cp, k, c)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_validation_errors():
     T, Cp, params, c = _setup((16, 32, 128))
     with pytest.raises(ValueError, match="k must be even"):
@@ -115,7 +138,10 @@ def test_validation_errors():
     with pytest.raises(ValueError, match="does not divide"):
         fused_diffusion_steps(T, Cp, 2, c, c, c, bx=7, by=16)
     with pytest.raises(ValueError, match="minor dimension"):
-        big = jnp.zeros((16, 32, 512), jnp.float32)
+        big = jnp.zeros((16, 32, 2048), jnp.float32)
         fused_diffusion_steps(big, jnp.ones_like(big), 2, c, c, c, bx=8, by=16)
+    with pytest.raises(ValueError, match="VMEM"):
+        wide = jnp.zeros((256, 256, 1024), jnp.float32)
+        fused_diffusion_steps(wide, jnp.ones_like(wide), 2, c, c, c, bx=128, by=128)
     with pytest.raises(ValueError, match="share a dtype"):
         fused_diffusion_steps(T, Cp.astype(jnp.bfloat16), 2, c, c, c, bx=8, by=16)
