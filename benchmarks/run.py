@@ -66,20 +66,25 @@ def _time_steps(step, state, chunk: int, reps: int):
     state = step(*state)  # compile + warmup
     _sync(state)
     # Sync-only round trip: state is already materialized, so this times the
-    # fetch RTT alone.
-    t0 = time.perf_counter()
-    _sync(state)
-    rtt_est = time.perf_counter() - t0
+    # fetch RTT alone.  Min over a few samples — a single sample can catch a
+    # drift spike and (over-subtracted below) inflate K enormously.
+    rtt_est = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(state)
+        rtt_est = min(rtt_est, time.perf_counter() - t0)
     # Work-only estimate from one ~20-call window (single sync at the end);
     # subtracting the measured RTT keeps K honest on fast configs, where one
     # RTT can otherwise inflate the estimate severalfold and shrink the
-    # window below the work target.
+    # window below the work target.  The subtraction is capped at half the
+    # elapsed time so a spiky RTT sample can never zero the estimate out.
     ncal = 20
     t0 = time.perf_counter()
     for _ in range(ncal):
         state = step(*state)
     _sync(state)
-    t_call_est = max((time.perf_counter() - t0 - rtt_est), 1e-4 * ncal) / ncal
+    elapsed = time.perf_counter() - t0
+    t_call_est = (elapsed - min(rtt_est, 0.5 * elapsed)) / ncal
     K = max(4, int(round(1.5 / t_call_est)))
     diffs = []
     b2_min = float("inf")

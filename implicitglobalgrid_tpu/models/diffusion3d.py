@@ -205,11 +205,15 @@ def make_multi_step(
     temporally-blocked Pallas kernel (`ops/pallas_stencil.py`) — the analogue
     of the reference's custom-kernel-when-generic-is-slow move
     (`/root/reference/src/update_halo.jl:430`), here lifting T_eff past the
-    streaming bound.  Only valid when no dimension has halo activity
-    (single block, non-periodic): between halo exchanges a width-2 overlap
-    admits one fresh step, so on a communicating grid the exchange cadence —
-    not the kernel — sets the step grouping.  Requires ``nsteps % fused_k
-    == 0`` and TPU-compatible shapes (see `fused_diffusion_steps`).
+    streaming bound.  On a grid with no halo activity (single block,
+    non-periodic) the kernel runs alone.  On a communicating grid the block
+    needs a **deep halo**: every dimension with halo activity must have
+    ``overlap >= 2*fused_k`` (``init_global_grid(..., overlapx=2*k, ...)``);
+    the chunk then alternates ``fused_k`` kernel steps with ONE slab
+    exchange (`update_halo(T, width=fused_k)`) — k steps per HBM pass *and*
+    per collective, so both the memory and the latency cost amortize.
+    Requires ``nsteps % fused_k == 0`` and TPU-compatible shapes (see
+    `fused_diffusion_steps`).
     """
     from jax import lax
 
@@ -221,35 +225,60 @@ def make_multi_step(
         if params.hide_comm:
             raise ValueError(
                 "fused_k and hide_comm are mutually exclusive: the fused "
-                "kernel runs only on grids with no halo activity, where "
-                "there is no communication to hide."
-            )
-        if any(nd > 1 or p for nd, p in zip(gg.dims, gg.periods)):
-            raise ValueError(
-                "fused_k requires a grid with no halo activity (all dims == 1 "
-                f"and non-periodic); got dims={gg.dims}, periods={gg.periods}. "
-                "On a communicating grid use the XLA path (one exchange per "
-                "step with the standard overlap=2)."
+                "kernel's slab exchange is already amortized over k steps; "
+                "overlap scheduling applies to the per-step XLA path."
             )
         if nsteps % fused_k != 0:
             raise ValueError(f"nsteps={nsteps} must be a multiple of fused_k={fused_k}")
         import jax
 
+        active = [
+            d for d in range(3) if gg.dims[d] > 1 or gg.periods[d]
+        ]
+        shallow = [d for d in active if gg.overlaps[d] < 2 * fused_k]
+        if shallow:
+            raise ValueError(
+                f"fused_k={fused_k} on a communicating grid needs a deep halo: "
+                f"overlap >= {2 * fused_k} in every dimension with halo "
+                f"activity, but dims {shallow} have overlaps "
+                f"{[gg.overlaps[d] for d in shallow]} (grid dims={gg.dims}, "
+                f"periods={gg.periods}). Re-init with overlap"
+                f"{'/'.join('xyz'[d] for d in shallow)}={2 * fused_k}, or use "
+                "the XLA path (one exchange per step)."
+            )
         cx = params.dt * params.lam / (params.dx * params.dx)
         cy = params.dt * params.lam / (params.dy * params.dy)
         cz = params.dt * params.lam / (params.dz * params.dz)
         bx, by = fused_tile if fused_tile is not None else (None, None)
 
-        def fused_chunk(T, Cp):
+        if not active:
+
+            def fused_chunk(T, Cp):
+                def body(i, T):
+                    return fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
+
+                T = lax.fori_loop(0, nsteps // fused_k, body, T)
+                return T, Cp
+
+            # No halo activity means no collectives: skip the shard_map
+            # wrapper and jit directly (fields are committed to the grid's
+            # single device).
+            return jax.jit(fused_chunk, donate_argnums=(0,) if donate else ())
+
+        def fused_block_step(T, Cp):
             def body(i, T):
-                return fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
+                T = fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
+                # One slab exchange licenses the next fused_k steps: the
+                # kernel's k-deep contaminated rind is exactly the region
+                # the width-k exchange refreshes, and the sent planes
+                # [ol-k, ol) sit at distance >= k from the block edge,
+                # where k kernel steps are still exact.
+                return update_halo(T, width=fused_k)
 
             T = lax.fori_loop(0, nsteps // fused_k, body, T)
             return T, Cp
 
-        # No halo activity means no collectives: skip the shard_map wrapper
-        # and jit directly (fields are committed to the grid's single device).
-        return jax.jit(fused_chunk, donate_argnums=(0,) if donate else ())
+        return stencil(fused_block_step, donate_argnums=(0,) if donate else ())
 
     update = _diffusion_update(params)
 

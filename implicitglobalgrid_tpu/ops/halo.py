@@ -14,7 +14,11 @@ Semantics ported exactly (with 0-based indices):
 * One plane per side per dimension is exchanged: send plane ``ol-1`` goes to
   the lower neighbor's plane ``n-1``; send plane ``n-ol`` goes to the upper
   neighbor's plane ``0`` (reference ``sendranges``/``recvranges``,
-  `/root/reference/src/update_halo.jl:544-563`).
+  `/root/reference/src/update_halo.jl:544-563`).  ``update_halo(...,
+  width=w)`` generalizes the plane to a ``w``-plane slab on deep-halo grids
+  (``overlap >= 2w``) — the TPU-first extension that lets ``w`` fused
+  stencil steps ride on one collective (temporal blocking; see
+  `ops/pallas_stencil.py` and `models/diffusion3d.py:make_multi_step`).
 * Dimensions are processed sequentially — the dim-``k`` exchange must see the
   dim-``k-1``-updated halos for corner correctness
   (`/root/reference/src/update_halo.jl:40`).  Here the sequencing is carried
@@ -150,14 +154,25 @@ def _set_plane(A, plane, index: int, dim: int):
     return lax.dynamic_update_slice_in_dim(A, plane.astype(A.dtype), index, axis=dim)
 
 
-def _get_plane(A, index: int, dim: int):
+def _get_plane(A, index: int, dim: int, width: int = 1):
     from jax import lax
 
-    return lax.slice_in_dim(A, index, index + 1, axis=dim)
+    return lax.slice_in_dim(A, index, index + width, axis=dim)
 
 
-def _exchange_dim(A, d: int, gg) -> "jax.Array":
-    """Exchange the two halo planes of local block ``A`` along dimension ``d``."""
+def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
+    """Exchange the two halo slabs (``width`` planes each) of block ``A``
+    along dimension ``d``.
+
+    ``width=1`` is the reference's exchange.  ``width=w>1`` is the deep-halo
+    generalization for temporal blocking: my planes ``[o-w, o)`` refresh the
+    lower neighbor's ``[n-w, n)`` and ``[n-o, n-o+w)`` refresh the upper
+    neighbor's ``[0, w)`` — one collective per ``w`` steps instead of ``w``
+    collectives, so the latency of a `collective_permute` hop amortizes over
+    ``w`` fused steps.  Valid iff ``ol >= 2*width`` (the sent planes must lie
+    at distance >= width from my own edge, where a width-deep stencil sweep
+    still has exact values).
+    """
     import jax.numpy as jnp
     from jax import lax
 
@@ -172,19 +187,29 @@ def _exchange_dim(A, d: int, gg) -> "jax.Array":
     n = shp[d]
     nd = gg.dims[d]
     periodic = bool(gg.periods[d])
+    if nd == 1 and not periodic:
+        return A  # no neighbors in this dimension
+    if o < 2 * width:
+        # Only dimensions that actually exchange need the deep halo.
+        raise ValueError(
+            f"update_halo(width={width}) needs overlap >= {2 * width} in "
+            f"dimension {d}; this field has ol={o}. Re-init the grid with "
+            f"overlap{'xyz'[d]}={2 * width} (deep halo) or use width=1."
+        )
     if nd == 1:
-        if not periodic:
-            return A  # no neighbors in this dimension
         # Self-neighbor fast path (reference: update_halo.jl:57-63): local copy.
-        lo_send = _get_plane(A, o - 1, d)
-        hi_send = _get_plane(A, n - o, d)
-        A = _set_plane(A, lo_send, n - 1, d)
+        lo_send = _get_plane(A, o - width, d, width)
+        hi_send = _get_plane(A, n - o, d, width)
+        A = _set_plane(A, lo_send, n - width, d)
         A = _set_plane(A, hi_send, 0, d)
         return A
 
     axis = AXIS_NAMES[d]
-    send_lo = _get_plane(A, o - 1, d)  # goes to lower neighbor (its plane n-1)
-    send_hi = _get_plane(A, n - o, d)  # goes to upper neighbor (its plane 0)
+    # Slabs go to the lower neighbor's top ``width`` planes / the upper
+    # neighbor's bottom ``width`` planes (reference sendranges/recvranges,
+    # generalized from one plane to a slab).
+    send_lo = _get_plane(A, o - width, d, width)
+    send_hi = _get_plane(A, n - o, d, width)
     perm_down = [(i, i - 1) for i in range(1, nd)]
     perm_up = [(i, i + 1) for i in range(nd - 1)]
     if periodic:
@@ -201,39 +226,44 @@ def _exchange_dim(A, d: int, gg) -> "jax.Array":
             "igg.stencil (or jax.shard_map over igg's mesh axes 'x','y','z')."
         ) from e
     if periodic:
-        A = _set_plane(A, recv_hi, n - 1, d)
+        A = _set_plane(A, recv_hi, n - width, d)
         A = _set_plane(A, recv_lo, 0, d)
     else:
         # Edge blocks have no source: ppermute delivered zeros there; keep the
-        # old boundary plane (the reference's PROC_NULL neighbors do nothing).
+        # old boundary slab (the reference's PROC_NULL neighbors do nothing).
         idx = lax.axis_index(axis)
-        A = _set_plane(A, jnp.where(idx < nd - 1, recv_hi, _get_plane(A, n - 1, d)), n - 1, d)
-        A = _set_plane(A, jnp.where(idx > 0, recv_lo, _get_plane(A, 0, d)), 0, d)
+        A = _set_plane(
+            A,
+            jnp.where(idx < nd - 1, recv_hi, _get_plane(A, n - width, d, width)),
+            n - width,
+            d,
+        )
+        A = _set_plane(A, jnp.where(idx > 0, recv_lo, _get_plane(A, 0, d, width)), 0, d)
     return A
 
 
-def _update_halo_local(fields: tuple, gg) -> tuple:
+def _update_halo_local(fields: tuple, gg, width: int = 1) -> tuple:
     """Per-block exchange of all fields, dimensions strictly in order x→y→z."""
     out = list(fields)
     for d in range(NDIMS):
         for i in range(len(out)):
-            out[i] = _exchange_dim(out[i], d, gg)
+            out[i] = _exchange_dim(out[i], d, gg, width)
     return tuple(out)
 
 
-def _global_update_fn(gg, shapes_dtypes):
+def _global_update_fn(gg, shapes_dtypes, width: int = 1):
     """Build (and cache) the jitted shard_map wrapper for one field signature."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    key = (gg.epoch, shapes_dtypes)
+    key = (gg.epoch, shapes_dtypes, width)
     fn = _jit_cache.get(key)
     if fn is not None:
         return fn
     ndims_per_field = tuple(len(s) for s, _ in shapes_dtypes)
 
     def exchange(*fields):
-        return _update_halo_local(fields, gg)
+        return _update_halo_local(fields, gg, width)
 
     if gg.nprocs == 1 and not gg.force_spmd:
         # 1-device grid: only self-neighbor local copies remain (no ppermute,
@@ -251,7 +281,7 @@ def _global_update_fn(gg, shapes_dtypes):
     return fn
 
 
-def update_halo(*fields):
+def update_halo(*fields, width: int = 1):
     """Update the halo planes of the given field(s).
 
     TPU-native counterpart of `update_halo!` (`/root/reference/src/update_halo.jl:25-78`).
@@ -260,6 +290,12 @@ def update_halo(*fields):
     compiles one fused program (the reference's pipelining advice,
     `/root/reference/src/update_halo.jl:13-14`); inputs are donated, so the
     update is buffer-in-place like the reference's mutating API.
+
+    ``width``: halo planes refreshed per side (default 1 = the reference's
+    exchange).  ``width=w`` on a deep-halo grid (``overlap >= 2w``) refreshes
+    ``w`` planes in one collective, licensing ``w`` stencil steps between
+    exchanges (temporal blocking, `make_multi_step(fused_k=w)`): the
+    per-hop latency of the exchange amortizes over ``w`` steps.
     """
     import jax
 
@@ -267,6 +303,8 @@ def update_halo(*fields):
     gg = _grid.global_grid()
     if not fields:
         raise ValueError("update_halo requires at least one field.")
+    if width < 1:
+        raise ValueError(f"width must be >= 1 (got {width})")
     check_fields(fields, gg)
     if any(_is_tracer(A) for A in fields):
         if not all(_is_tracer(A) for A in fields):
@@ -277,7 +315,7 @@ def update_halo(*fields):
                 "fields to be local-block tracers; pass captured global-block "
                 "fields as arguments of the stencil function instead."
             )
-        out = _update_halo_local(tuple(fields), gg)
+        out = _update_halo_local(tuple(fields), gg, width)
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -288,5 +326,5 @@ def update_halo(*fields):
                 A = jax.device_put(np.asarray(A), NamedSharding(gg.mesh, spec))
             arrs.append(A)
         sig = tuple((local_shape(A, gg), str(A.dtype)) for A in arrs)
-        out = _global_update_fn(gg, sig)(*arrs)
+        out = _global_update_fn(gg, sig, width)(*arrs)
     return out[0] if len(fields) == 1 else tuple(out)

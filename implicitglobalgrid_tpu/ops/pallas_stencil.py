@@ -72,8 +72,7 @@ def _tile_error(n0, n1, n2, k, bx, by, itemsize):
         return "by and the y-size must be multiples of 8 (DMA alignment)"
     if bx + 2 * k > n0 or by + 2 * H > n1:
         return f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k"
-    if n1 // by < 2:
-        return f"need >= 2 y-tiles (got {n1 // by}); shrink by"
+    # (by | n1 and by + 2H <= n1 with H >= 8 already force >= 2 y-tiles.)
     return None
 
 
@@ -116,6 +115,11 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
     if bx is None and by is None:
         picked = default_tile((n0, n1, n2), k, T.dtype.itemsize)
         if picked is None:
+            if n1 % 8 != 0:
+                raise ValueError(
+                    f"y-size {n1} is not a multiple of 8 (DMA sublane "
+                    "alignment); no tile can fit — use the XLA path"
+                )
             raise ValueError(
                 f"no tuned tile candidate {_TILE_CANDIDATES} fits volume "
                 f"({n0},{n1},{n2}) with k={k}; pass bx/by explicitly"

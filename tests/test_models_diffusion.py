@@ -124,3 +124,37 @@ def test_multi_step_matches_single_steps():
         np.asarray(s1[0]), np.asarray(s6[0]), rtol=1e-12, atol=1e-13
     )
     igg.finalize_global_grid()
+
+
+def test_fused_deep_halo_matches_xla_multiblock():
+    """Temporal blocking on a communicating grid: k fused kernel steps + one
+    width-k slab exchange must match the per-step XLA path on the same mesh
+    (interpret-mode kernel; deep halo overlapx=4 licenses fused_k=2)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 4
+    kw = dict(
+        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, overlapx=4, quiet=True
+    )
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    step = diffusion3d.make_multi_step(params, nt, donate=False)
+    state = jax.block_until_ready(step(*state))
+    T_xla = np.asarray(igg.gather(state[0]))
+    igg.finalize_global_grid()
+
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = diffusion3d.make_multi_step(params, nt, donate=False, fused_k=2)
+        state = jax.block_until_ready(stepf(*state))
+    T_fused = np.asarray(igg.gather(state[0]))
+    igg.finalize_global_grid()
+    np.testing.assert_allclose(T_fused, T_xla, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_requires_deep_halo():
+    state, params = diffusion3d.setup(
+        16, 32, 128, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, quiet=True
+    )
+    with pytest.raises(ValueError, match="deep halo"):
+        diffusion3d.make_multi_step(params, 4, fused_k=2)
+    igg.finalize_global_grid()

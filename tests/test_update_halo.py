@@ -48,9 +48,11 @@ def unblocks(blocks, dims, lshape, nd, dtype):
     return g
 
 
-def simulate_update_halo(global_np, gg):
-    """Numpy re-implementation of the reference exchange for one field."""
+def simulate_update_halo(global_np, gg, width=1):
+    """Numpy re-implementation of the reference exchange for one field
+    (``width`` planes per side; width=1 is the reference's exchange)."""
     nd = global_np.ndim
+    w = width
     lshape = tuple(s // gg.dims[d] for d, s in enumerate(global_np.shape))
     blocks = blocks_of(global_np, gg.dims, lshape)
     for d in range(3):
@@ -69,28 +71,28 @@ def simulate_update_halo(global_np, gg):
         for c, b in blocks.items():
             sl_lo = [slice(None)] * nd
             sl_hi = [slice(None)] * nd
-            sl_lo[d] = slice(o - 1, o)
-            sl_hi[d] = slice(n - o, n - o + 1)
+            sl_lo[d] = slice(o - w, o)
+            sl_hi[d] = slice(n - o, n - o + w)
             sends[c] = (b[tuple(sl_lo)].copy(), b[tuple(sl_hi)].copy())
         # unpack
         for c, b in blocks.items():
             ci = list(c)
-            # receive into hi plane (n-1) from upper neighbor's lo send
+            # receive into hi slab [n-w, n) from upper neighbor's lo send
             ci[d] = c[d] + 1
             if ci[d] >= D:
                 ci[d] = 0 if per else None
             if ci[d] is not None:
                 sl = [slice(None)] * nd
-                sl[d] = slice(n - 1, n)
+                sl[d] = slice(n - w, n)
                 b[tuple(sl)] = sends[tuple(ci)][0]
-            # receive into lo plane (0) from lower neighbor's hi send
+            # receive into lo slab [0, w) from lower neighbor's hi send
             ci = list(c)
             ci[d] = c[d] - 1
             if ci[d] < 0:
                 ci[d] = D - 1 if per else None
             if ci[d] is not None:
                 sl = [slice(None)] * nd
-                sl[d] = slice(0, 1)
+                sl[d] = slice(0, w)
                 b[tuple(sl)] = sends[tuple(ci)][1]
     return unblocks(blocks, gg.dims, lshape, nd, global_np.dtype)
 
@@ -114,7 +116,7 @@ def put(arr_np):
     return jax.device_put(jnp.asarray(arr_np), NamedSharding(gg.mesh, spec))
 
 
-def check(config, fields_lshapes, dtype=np.float64, **initkw):
+def check(config, fields_lshapes, dtype=np.float64, width=1, **initkw):
     nx, ny, nz = config
     igg.init_global_grid(nx, ny, nz, quiet=True, **initkw)
     gg = igg.get_global_grid()
@@ -122,11 +124,11 @@ def check(config, fields_lshapes, dtype=np.float64, **initkw):
     # Low-precision dtypes can't hold unique large integers: recode small.
     if np.dtype(dtype) in (np.dtype(np.float16), np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.dtype(np.float16)):
         fields = [np.mod(f, 512).astype(dtype) for f in fields]
-    outs = igg.update_halo(*[put(f) for f in fields])
+    outs = igg.update_halo(*[put(f) for f in fields], width=width)
     if len(fields) == 1:
         outs = (outs,)
     for f, o in zip(fields, outs):
-        exp = simulate_update_halo(f, gg)
+        exp = simulate_update_halo(f, gg, width)
         np.testing.assert_array_equal(np.asarray(o).astype(np.float64), exp.astype(np.float64))
     igg.finalize_global_grid()
 
@@ -206,6 +208,36 @@ def test_custom_overlaps():
 
 def test_overlap3_periodic():
     check((8, 8, 8), [(8, 8, 8)], overlapx=3, periodx=1)
+
+
+def test_slab_width2():
+    # Deep-halo slab exchange (width=2 on overlap-4 grids): the temporal-
+    # blocking transport (one collective per k fused steps).
+    check((8, 8, 8), [(8, 8, 8)], width=2, overlapx=4, overlapy=4, overlapz=4)
+    check((8, 8, 8), [(8, 8, 8)], width=2, overlapx=4, overlapy=4, overlapz=4,
+          periodx=1, periodz=1)
+
+
+def test_slab_width2_self_neighbor():
+    # width-2 local slab copy on a periodic single-block dimension
+    check((8, 8, 8), [(8, 8, 8)], width=2, overlapx=4, overlapy=4, overlapz=4,
+          dimy=1, periody=1, dimx=4, dimz=2)
+
+
+def test_slab_width3_mixed_overlaps():
+    # width-3 slabs; a dimension without halo activity may stay shallow
+    check((12, 12, 8), [(12, 12, 8)], width=3, overlapx=6, overlapy=6,
+          overlapz=6, periody=1)
+
+
+def test_slab_width_needs_deep_overlap():
+    igg.init_global_grid(8, 8, 8, quiet=True)  # default overlap 2
+    A = put(unique_field((8, 8, 8), igg.get_global_grid()))
+    with pytest.raises(ValueError, match="overlap >= 4"):
+        igg.update_halo(A, width=2)
+    with pytest.raises(ValueError, match="width must be >= 1"):
+        igg.update_halo(A, width=0)
+    igg.finalize_global_grid()
 
 
 def test_2d():
