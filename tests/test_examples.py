@@ -50,3 +50,24 @@ def test_tpu_onlyvis_importable():
     for name in ("diffusion3d_tpu", "diffusion3d_tpu_novis", "diffusion3d_tpu_onlyvis"):
         _load(name)
     assert not igg.grid_is_initialized()
+
+
+def test_tpu_fused_runs():
+    # The deep-halo temporal-blocking example on the virtual mesh (interpret-
+    # mode kernel; overlap=2k licenses fused_k=k on the communicating grid).
+    from jax.experimental.pallas import tpu as pltpu
+
+    import implicitglobalgrid_tpu as igg
+
+    import jax
+
+    mod = _load("diffusion3d_tpu_fused")
+    with pltpu.force_tpu_interpret_mode():
+        T = mod.diffusion3d_fused(
+            nx=32, nt=4, k=2, quiet=True,
+            devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        )
+    T = np.asarray(T)
+    gshape = T.shape
+    assert np.isfinite(T).all() and T.max() > 0
+    assert not igg.grid_is_initialized()

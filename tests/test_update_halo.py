@@ -230,6 +230,19 @@ def test_slab_width3_mixed_overlaps():
           overlapz=6, periody=1)
 
 
+def test_slab_width2_staggered():
+    # Staggered fields slab-exchange with shape-aware ol (ol = overlap + 1
+    # for the +1-sized axis), all in one call.
+    check(
+        (8, 8, 8),
+        [(8, 8, 8), (9, 8, 8), (8, 9, 8)],
+        width=2,
+        overlapx=4,
+        overlapy=4,
+        overlapz=4,
+    )
+
+
 def test_slab_width_needs_deep_overlap():
     igg.init_global_grid(8, 8, 8, quiet=True)  # default overlap 2
     A = put(unique_field((8, 8, 8), igg.get_global_grid()))
