@@ -97,6 +97,14 @@ def main():
         r = _bench.bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", emit=False)
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
+    def _acoustic_overlap():
+        # BASELINE config 3 promises overlap on/off; on 1 chip the delta is
+        # scheduling noise (no neighbors), recorded for artifact completeness.
+        r = _bench.bench_acoustic(
+            n=192, chunk=25, reps=4, dtype="float32", emit=False, hide_comm=True
+        )
+        return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
+
     def _porous():
         r = _bench.bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", emit=False)
         return {
@@ -109,6 +117,7 @@ def main():
     _extra("diffusion_512_pallas_fused4", _fused512)
     _extra("diffusion_xla_overlap", _overlap)
     _extra("acoustic", _acoustic)
+    _extra("acoustic_overlap", _acoustic_overlap)
     _extra("porous_pt", _porous)
     best = rec["value"]
     fused = extras.get("diffusion_pallas_fused4", {})

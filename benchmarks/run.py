@@ -63,8 +63,25 @@ def _time_steps(step, state, chunk: int, reps: int):
     and clamped into the physically possible band derived from the fastest
     2K window (see the comment at the clamp).
     """
+    import jax
+
     state = step(*state)  # compile + warmup
     _sync(state)
+    # Virtual-CPU meshes (weak-scaling code-path validation) share one core:
+    # a window of unsynced dispatches starves the device threads past the
+    # XLA-CPU collective rendezvous timeout.  Sync every call there — CPU
+    # timings are code-path checks, not performance numbers.
+    leaf = state[0] if isinstance(state, (tuple, list)) else state
+    sync_each = leaf.devices().pop().platform == "cpu"
+
+    def run_window(state, ncalls):
+        for _ in range(ncalls):
+            state = step(*state)
+            if sync_each:
+                jax.block_until_ready(state)
+        _sync(state)
+        return state
+
     # Sync-only round trip: state is already materialized, so this times the
     # fetch RTT alone.  Min over a few samples — a single sample can catch a
     # drift spike and (over-subtracted below) inflate K enormously.
@@ -80,9 +97,7 @@ def _time_steps(step, state, chunk: int, reps: int):
     # elapsed time so a spiky RTT sample can never zero the estimate out.
     ncal = 20
     t0 = time.perf_counter()
-    for _ in range(ncal):
-        state = step(*state)
-    _sync(state)
+    state = run_window(state, ncal)
     elapsed = time.perf_counter() - t0
     t_call_est = (elapsed - min(rtt_est, 0.5 * elapsed)) / ncal
     K = max(4, int(round(1.5 / t_call_est)))
@@ -90,14 +105,10 @@ def _time_steps(step, state, chunk: int, reps: int):
     b2_min = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(K):
-            state = step(*state)
-        _sync(state)
+        state = run_window(state, K)
         b1 = time.perf_counter() - t0
         t0 = time.perf_counter()
-        for _ in range(2 * K):
-            state = step(*state)
-        _sync(state)
+        state = run_window(state, 2 * K)
         b2 = time.perf_counter() - t0
         b2_min = min(b2_min, b2)
         diffs.append((b2 - b1) / (K * chunk))
