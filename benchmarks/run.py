@@ -143,7 +143,8 @@ def _emit(name, teff, t_it, extra=None, emit=True):
 
 
 def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
-                    devices=None, emit=True, fused_k=None, force_spmd=False):
+                    devices=None, emit=True, fused_k=None, fused_tile=None,
+                    force_spmd=False):
     """Benchmarks run with ``donate=False``: buffer donation costs ~3x on the
     tunneled single-chip backend used for the round measurements (measured:
     375 -> 119 GB/s at 256^3 f32; identical HLO, runtime-side penalty), and
@@ -163,7 +164,9 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices, force_spmd=force_spmd,
     )
-    step = diffusion3d.make_multi_step(params, chunk, donate=False, fused_k=fused_k)
+    step = diffusion3d.make_multi_step(
+        params, chunk, donate=False, fused_k=fused_k, fused_tile=fused_tile
+    )
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()

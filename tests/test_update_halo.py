@@ -284,7 +284,7 @@ def test_multi_field_mixed_dtypes():
 
 
 @pytest.mark.parametrize(
-    "dtype", ["float16", "bfloat16", "float32", "float64", "int32", "complex64"]
+    "dtype", ["float16", "bfloat16", "float32", "float64", "int16", "int32", "complex64"]
 )
 def test_dtypes(dtype):
     # reference dtype matrix: test_update_halo.jl:109-177,938-952
