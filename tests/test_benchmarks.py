@@ -1,0 +1,57 @@
+"""Smoke tests for the benchmark harness (`benchmarks/run.py`).
+
+The harness is a shipped artifact (BASELINE.md promises every config as
+code), so its code paths are tested like library code — on the virtual CPU
+mesh, with tiny volumes.  Timings here are code-path validation only; the
+real numbers come from `bench.py` on the TPU chip.  The weak-scaling stall
+of round 2 (unsynced windows starving the single-core collective
+rendezvous) is exactly the class of regression these tests pin.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "igg_bench_under_test", os.path.join(_root, "benchmarks", "run.py")
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _assert_record(rec, metric_prefix):
+    assert rec["metric"].startswith(metric_prefix)
+    assert rec["unit"] == "GB/s/chip"
+    assert np.isfinite(rec["value"]) and rec["value"] > 0
+    assert rec["t_it_ms"] > 0
+
+
+def test_bench_diffusion_smoke():
+    rec = bench.bench_diffusion(n=16, chunk=2, reps=1, emit=False)
+    _assert_record(rec, "diffusion3d_16")
+    assert rec["nprocs"] == 8  # ran on the full virtual mesh
+
+
+def test_bench_diffusion_multidevice_spmd():
+    # The force_spmd path the weak-scaling bench uses (collectives in the
+    # timed loop — the config that stalled when windows stopped syncing).
+    import jax
+
+    rec = bench.bench_diffusion(
+        n=16, chunk=2, reps=1, emit=False, devices=jax.devices()[:2], force_spmd=True
+    )
+    _assert_record(rec, "diffusion3d_16")
+    assert rec["nprocs"] == 2
+
+
+def test_bench_acoustic_smoke():
+    rec = bench.bench_acoustic(n=16, chunk=2, reps=1, emit=False)
+    _assert_record(rec, "acoustic3d_16")
+
+
+def test_bench_porous_smoke():
+    rec = bench.bench_porous(n=16, chunk=1, reps=1, npt=2, emit=False)
+    _assert_record(rec, "porous_convection3d_16")
+    assert rec["t_pt_ms"] > 0

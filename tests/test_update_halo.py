@@ -376,3 +376,21 @@ def test_collective_permute_count():
     n_cp = hlo.count(" collective-permute(")
     n_cp_start = hlo.count(" collective-permute-start(")
     assert n_cp + n_cp_start == 2 * exchanged_dims * nfields
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_config_sweep(seed):
+    # Property sweep: random topology/periods/overlaps/staggering/width
+    # against the numpy simulator (the reference relies on hand-enumerated
+    # configs; the sweep guards the combinations nobody thought to write).
+    rng = np.random.default_rng(1000 + seed)
+    width = int(rng.integers(1, 4))
+    o = 2 * width + int(rng.integers(0, 2))
+    lshape = tuple(int(rng.integers(2 * o, 2 * o + 4)) for _ in range(3))
+    periods = {f"period{ax}": int(rng.integers(0, 2)) for ax in "xyz"}
+    overlaps = {f"overlap{ax}": o for ax in "xyz"}
+    stag = [
+        tuple(n + int(rng.integers(0, 2)) for n in lshape),
+        lshape,
+    ]
+    check(lshape, stag, width=width, **periods, **overlaps)
