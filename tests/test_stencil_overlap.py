@@ -137,8 +137,15 @@ def test_fields_constructors():
     assert o.shape == (gg.dims[0] * 4, gg.dims[1] * 4) and o.dtype == jnp.float32
     assert f.shape == (gg.dims[0] * 4,)
     assert float(np.asarray(f)[0]) == 2.5
-    # sharding: one block per device along the mesh
-    assert len(z.sharding.device_set) == 8
+    # sharding: one block per device along the mesh.  Assert on the actual
+    # shard placement, not `sharding.device_set` — after shard-data fetches
+    # elsewhere in the process (e.g. the benchmark harness's element-fetch
+    # sync) that cached set under-counts devices on this jax version even
+    # though placement and collectives remain correct (verified: 8 shards on
+    # 8 distinct devices, correct update_halo results).
+    assert len(z.addressable_shards) == 8
+    assert len({s.device.id for s in z.addressable_shards}) == 8
+    assert {tuple(s.data.shape) for s in z.addressable_shards} == {(4, 4, 4)}
 
 
 def test_hide_communication_lower_rank_aux_field():
