@@ -1,0 +1,121 @@
+"""One-command hardware validation on a real TPU chip.
+
+Runs the end-to-end drives that the CPU test suite cannot: compiled (not
+interpreted) kernels and exchanges on the attached chip, via the public API
+only.  Complements `python -m pytest tests/` (virtual 8-device CPU mesh) and
+`python bench.py` (performance).
+
+    python scripts/verify_tpu.py
+
+Checks:
+ 1. periodic self-neighbor halo restoration on the chip,
+ 2. fused Pallas kernel vs the XLA path (few-ULP, ring bit-exact),
+ 3. deep-halo temporal blocking (fused + width-k slab exchange) vs the
+    per-step XLA path on a communicating (periodic) grid,
+ 4. example `diffusion3d_tpu_fused` end-to-end.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def sync(x):
+    """Honest completion sync: fetch one element (block_until_ready can
+    return early on tunneled backends — see docs/performance.md)."""
+    shard = x.addressable_shards[0].data
+    float(shard[(0,) * shard.ndim])
+    return x
+
+
+def check_self_neighbor():
+    import jax
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    state, params = diffusion3d.setup(
+        32, 32, 32, periodx=1, quiet=True, dtype=jax.numpy.float32
+    )
+    step = diffusion3d.make_step(params)
+    for _ in range(3):
+        state = step(*state)
+    T = np.asarray(sync(state[0]))
+    o = igg.get_global_grid().overlaps[0]
+    assert np.array_equal(T[-1], T[o - 1]), "self-neighbor hi plane"
+    assert np.array_equal(T[0], T[-o]), "self-neighbor lo plane"
+    igg.finalize_global_grid()
+    print("1. periodic self-neighbor halo: OK")
+
+
+def check_fused_vs_xla():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    state, params = diffusion3d.setup(64, 128, 256, quiet=True, dtype=jnp.float32)
+    xla = diffusion3d.make_multi_step(params, 4, donate=False)
+    fused = diffusion3d.make_multi_step(params, 4, donate=False, fused_k=4)
+    ref = np.asarray(sync(xla(*state)[0]))
+    got = np.asarray(sync(fused(*state)[0]))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    T0 = np.asarray(state[0])
+    for ax in range(3):
+        assert np.array_equal(np.take(got, 0, axis=ax), np.take(T0, 0, axis=ax))
+    igg.finalize_global_grid()
+    print(f"2. fused kernel vs XLA (compiled): OK, max|d|={np.max(np.abs(got - ref)):.2e}")
+
+
+def check_deep_halo_slab():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    kw = dict(periodz=1, overlapz=4, quiet=True, dtype=jnp.float32)
+    state, params = diffusion3d.setup(64, 64, 256, **kw)
+    sx = diffusion3d.make_multi_step(params, 4, donate=False)
+    sf = diffusion3d.make_multi_step(params, 4, donate=False, fused_k=2)
+    ref = np.asarray(sync(sx(*state)[0]))
+    got = np.asarray(sync(sf(*state)[0]))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    igg.finalize_global_grid()
+    print(
+        "3. deep-halo temporal blocking (fused + width-2 slab exchange): OK, "
+        f"max|d|={np.max(np.abs(got - ref)):.2e}"
+    )
+
+
+def check_example():
+    import importlib.util
+
+    import numpy as np
+
+    ex = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples",
+        "diffusion3d_tpu_fused.py",
+    )
+    spec = importlib.util.spec_from_file_location("dtf", ex)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    T = mod.diffusion3d_fused(nx=128, nt=40, k=2, quiet=True)
+    assert np.isfinite(np.asarray(T)).all()
+    print("4. fused example end-to-end: OK")
+
+
+if __name__ == "__main__":
+    import jax
+
+    print("device:", jax.devices()[0].device_kind)
+    check_self_neighbor()
+    check_fused_vs_xla()
+    check_deep_halo_slab()
+    check_example()
+    print("ALL TPU CHECKS PASSED")
