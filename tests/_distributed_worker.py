@@ -90,6 +90,24 @@ assert igg.gather(T, buf, root=ROOT) is None
 if jax.process_index() == ROOT:
     assert np.array_equal(buf, got)
 
+# Deep-halo slab exchange across the real process boundary: re-init with
+# overlap=4 (keeping the runtime up — the reference's finalize_MPI=false
+# cycle), then check width-2 exchange idempotence on a coordinate-derived
+# field: duplicated cells are consistent by construction, so a correct slab
+# exchange is a bitwise no-op, and any wrong plane/offset would break it.
+igg.finalize_global_grid(finalize_distributed=False)
+assert dist.is_distributed_initialized()
+igg.init_global_grid(
+    NX, NX, NX, overlapx=4, overlapy=4, overlapz=4, quiet=True
+)
+state2, _ = diffusion3d.setup(NX, NX, NX, init_grid=False)
+T2 = state2[0]
+import jax.numpy as jnp
+
+out2 = igg.update_halo(T2 + 0, width=2)  # +0: update_halo donates its input
+d = float(jax.jit(lambda a, b: jnp.max(jnp.abs(a - b)))(out2, T2))
+assert d == 0.0, f"width-2 slab exchange not idempotent on consistent field: {d}"
+
 igg.finalize_global_grid()
 assert not igg.grid_is_initialized()
 assert not dist.is_distributed_initialized()  # finalize tore the runtime down
