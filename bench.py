@@ -56,7 +56,7 @@ def main():
     # (metric name unchanged from round 1 for comparability).  The XLA path
     # is the always-available fallback if the Pallas kernel fails on some
     # backend.
-    rec = _bench.bench_diffusion(n=256, chunk=24, reps=6, dtype="float32", emit=False)
+    rec = _bench.bench_diffusion(n=256, chunk=24, reps=4, dtype="float32", emit=False)
     extras = {"diffusion_xla": {"teff": rec["value"], "t_it_ms": rec["t_it_ms"]}}
 
     def _extra(name, fn):
@@ -69,7 +69,7 @@ def main():
 
     def _fused():
         r = _bench.bench_diffusion(
-            n=256, chunk=24, reps=6, dtype="float32", emit=False, fused_k=4
+            n=256, chunk=24, reps=4, dtype="float32", emit=False, fused_k=4
         )
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
@@ -80,14 +80,14 @@ def main():
         # at this size.  (32,128) measures ~7% over the (32,64) default at
         # this volume (lower halo-recompute redundancy, 1.41x vs 1.56x).
         r = _bench.bench_diffusion(
-            n=512, chunk=24, reps=4, dtype="float32", emit=False, fused_k=4,
+            n=512, chunk=24, reps=3, dtype="float32", emit=False, fused_k=4,
             fused_tile=(32, 128),
         )
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
     def _overlap():
         r = _bench.bench_diffusion(
-            n=256, chunk=24, reps=6, dtype="float32", emit=False, hide_comm=True
+            n=256, chunk=24, reps=3, dtype="float32", emit=False, hide_comm=True
         )
         return {
             "teff": r["value"],
@@ -96,14 +96,14 @@ def main():
         }
 
     def _acoustic():
-        r = _bench.bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", emit=False)
+        r = _bench.bench_acoustic(n=192, chunk=25, reps=3, dtype="float32", emit=False)
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
     def _acoustic_overlap():
         # BASELINE config 3 promises overlap on/off; on 1 chip the delta is
         # scheduling noise (no neighbors), recorded for artifact completeness.
         r = _bench.bench_acoustic(
-            n=192, chunk=25, reps=4, dtype="float32", emit=False, hide_comm=True
+            n=192, chunk=25, reps=3, dtype="float32", emit=False, hide_comm=True
         )
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
