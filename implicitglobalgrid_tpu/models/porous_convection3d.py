@@ -34,6 +34,7 @@ from .. import (
     update_halo,
     zeros,
 )
+from ..ops.overlap import hide_communication
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +54,7 @@ class Params:
     beta_p: float = 0.0  # PT relaxation for pressure (set in setup, see bound below)
     npt: int = 20  # PT iterations per time step
     dtype: Any = None
+    hide_comm: bool = False
 
 
 def _inn(A):
@@ -71,6 +73,7 @@ def setup(
     dT: float = 1.0,
     npt: int = 20,
     dtype=None,
+    hide_comm: bool = False,
     init_grid: bool = True,
     **grid_kwargs,
 ):
@@ -107,7 +110,7 @@ def setup(
     params = Params(
         Ra=Ra, lx=lx, ly=ly, lz=lz, dT=dT, phi=phi, lam_T=lam_T,
         dx=dx, dy=dy, dz=dz, dt=dt, theta_q=theta_q, beta_p=beta_p,
-        npt=int(npt), dtype=dtype,
+        npt=int(npt), dtype=dtype, hide_comm=hide_comm,
     )
 
     T0 = zeros((nx, ny, nz), dtype)
@@ -140,7 +143,9 @@ def _pt_iteration(params: Params):
     """One pseudo-transient Darcy relaxation: flux update (+buoyancy), halo
     exchange of the fluxes, pressure update.  Pf needs no exchange — it is
     recomputed at every cell from post-exchange fluxes (same argument as the
-    acoustic model's pressure)."""
+    acoustic model's pressure).  With ``params.hide_comm`` the flux exchange
+    overlaps the interior flux update (`hide_communication`), mirroring the
+    acoustic model's velocity phase."""
     import jax.numpy as jnp
 
     th = params.theta_q
@@ -151,7 +156,7 @@ def _pt_iteration(params: Params):
         # T averaged onto interior z-faces: (nx-2, ny-2, nz-1)
         return 0.5 * (T[1:-1, 1:-1, 1:] + T[1:-1, 1:-1, :-1])
 
-    def iteration(T, Pf, qDx, qDy, qDz):
+    def flux_update(T, Pf, qDx, qDy, qDz):
         # Darcy flux relaxation toward -grad(Pf) + Ra*T e_z (interior faces).
         fx = -jnp.diff(Pf[:, 1:-1, 1:-1], axis=0) / dx
         fy = -jnp.diff(Pf[1:-1, :, 1:-1], axis=1) / dy
@@ -159,7 +164,21 @@ def _pt_iteration(params: Params):
         qDx = qDx + jnp.pad(th * (fx - _inn(qDx)), 1)
         qDy = qDy + jnp.pad(th * (fy - _inn(qDy)), 1)
         qDz = qDz + jnp.pad(th * (fz - _inn(qDz)), 1)
-        qDx, qDy, qDz = update_halo(qDx, qDy, qDz)
+        return qDx, qDy, qDz
+
+    if params.hide_comm:
+        overlapped = hide_communication(flux_update, radius=1)
+
+        def fluxes_exchanged(T, Pf, qDx, qDy, qDz):
+            return overlapped(T, Pf, qDx, qDy, qDz)
+
+    else:
+
+        def fluxes_exchanged(T, Pf, qDx, qDy, qDz):
+            return update_halo(*flux_update(T, Pf, qDx, qDy, qDz))
+
+    def iteration(T, Pf, qDx, qDy, qDz):
+        qDx, qDy, qDz = fluxes_exchanged(T, Pf, qDx, qDy, qDz)
         div = (
             jnp.diff(qDx, axis=0) / dx
             + jnp.diff(qDy, axis=1) / dy
