@@ -179,12 +179,72 @@ def make_step(params: Params, *, donate: bool = True):
     return stencil(block_step, donate_argnums=donate_argnums)
 
 
-def make_multi_step(params: Params, nsteps: int, *, donate: bool = True):
-    """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`)."""
+def make_multi_step(
+    params: Params, nsteps: int, *, donate: bool = True, exchange_every: int = 1
+):
+    """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`).
+
+    ``exchange_every=w``: on a deep-halo grid (``overlap >= 2w``) run ``w``
+    leapfrog steps between exchanges and then exchange width-``w`` slabs of
+    ALL four fields in one collective call — unlike the per-step path, the
+    incrementally-updated ``P`` must be exchanged too (its stale rind is
+    never recomputed from fresh velocities, so the slab replaces it with the
+    neighbor's still-exact planes).  One collective per ``w`` steps;
+    bit-identical states at group boundaries.
+    """
     from jax import lax
 
     v_update = _velocity_update(params)
     p_update = _pressure_update(params)
+
+    if exchange_every < 1:
+        raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")
+    if exchange_every > 1:
+        from ..parallel.grid import global_grid
+
+        if params.hide_comm:
+            raise ValueError(
+                "exchange_every and hide_comm are mutually exclusive: overlap "
+                "scheduling hides the per-step exchange; a slab cadence "
+                "replaces it."
+            )
+        if nsteps % exchange_every != 0:
+            raise ValueError(
+                f"nsteps={nsteps} must be a multiple of exchange_every={exchange_every}"
+            )
+        gg = global_grid()
+        shallow = [
+            d
+            for d in range(3)
+            if (gg.dims[d] > 1 or gg.periods[d])
+            and gg.overlaps[d] < 2 * exchange_every
+        ]
+        if shallow:
+            raise ValueError(
+                f"exchange_every={exchange_every} needs a deep halo: overlap >= "
+                f"{2 * exchange_every} in every dimension with halo activity, "
+                f"but dims {shallow} have overlaps "
+                f"{[gg.overlaps[d] for d in shallow]}."
+            )
+        w = exchange_every
+
+        def block_step(P, Vx, Vy, Vz):
+            def group(i, s):
+                def body(j, s):
+                    P, Vx, Vy, Vz = s
+                    Vx, Vy, Vz = v_update(P, Vx, Vy, Vz)
+                    P = p_update(P, Vx, Vy, Vz)
+                    return (P, Vx, Vy, Vz)
+
+                P, Vx, Vy, Vz = lax.fori_loop(0, w, body, s)
+                P, Vx, Vy, Vz = update_halo(P, Vx, Vy, Vz, width=w)
+                return (P, Vx, Vy, Vz)
+
+            return lax.fori_loop(0, nsteps // w, group, (P, Vx, Vy, Vz))
+
+        donate_argnums = tuple(range(4)) if donate else ()
+        return stencil(block_step, donate_argnums=donate_argnums)
+
     if params.hide_comm:
         v_exchange = hide_communication(v_update, radius=1)
     else:

@@ -194,12 +194,22 @@ def make_multi_step(
     donate: bool = True,
     fused_k: int | None = None,
     fused_tile: tuple[int, int] | None = None,
+    exchange_every: int = 1,
 ):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
     TPU-first: the whole loop is one XLA program, so per-call dispatch
     overhead amortizes away and the compiler schedules across iterations —
     use this for production runs and benchmarks.
+
+    ``exchange_every=w`` (XLA path): on a deep-halo grid (``overlap >= 2w``
+    in every dimension with halo activity) run ``w`` stencil steps between
+    halo exchanges and exchange a width-``w`` slab — one collective per
+    ``w`` steps, bit-identical results at group boundaries (the w-deep stale
+    rind each block accumulates is exactly the slab the exchange replaces
+    with the neighbor's still-exact planes).  The latency-amortization half
+    of the deep-halo story without the Pallas kernel; combine with
+    ``fused_k=w`` to also amortize HBM traffic.
 
     ``fused_k``: advance ``fused_k`` steps per HBM pass with the
     temporally-blocked Pallas kernel (`ops/pallas_stencil.py`) — the analogue
@@ -230,6 +240,11 @@ def make_multi_step(
             )
         if nsteps % fused_k != 0:
             raise ValueError(f"nsteps={nsteps} must be a multiple of fused_k={fused_k}")
+        if exchange_every not in (1, fused_k):
+            raise ValueError(
+                f"fused_k={fused_k} already exchanges every fused_k steps; "
+                f"exchange_every={exchange_every} conflicts."
+            )
         import jax
 
         active = [
@@ -281,6 +296,47 @@ def make_multi_step(
         return stencil(fused_block_step, donate_argnums=(0,) if donate else ())
 
     update = _diffusion_update(params)
+
+    if exchange_every < 1:
+        raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")
+    if exchange_every > 1:
+        from ..parallel.grid import global_grid
+
+        if params.hide_comm:
+            raise ValueError(
+                "exchange_every and hide_comm are mutually exclusive: overlap "
+                "scheduling hides the per-step exchange; a slab cadence "
+                "replaces it."
+            )
+        if nsteps % exchange_every != 0:
+            raise ValueError(
+                f"nsteps={nsteps} must be a multiple of exchange_every={exchange_every}"
+            )
+        gg = global_grid()
+        shallow = [
+            d
+            for d in range(3)
+            if (gg.dims[d] > 1 or gg.periods[d])
+            and gg.overlaps[d] < 2 * exchange_every
+        ]
+        if shallow:
+            raise ValueError(
+                f"exchange_every={exchange_every} needs a deep halo: overlap >= "
+                f"{2 * exchange_every} in every dimension with halo activity, "
+                f"but dims {shallow} have overlaps "
+                f"{[gg.overlaps[d] for d in shallow]}."
+            )
+        w = exchange_every
+
+        def block_step(T, Cp):
+            def group(i, T):
+                T = lax.fori_loop(0, w, lambda j, T: update(T, Cp), T)
+                return update_halo(T, width=w)
+
+            T = lax.fori_loop(0, nsteps // w, group, T)
+            return T, Cp
+
+        return stencil(block_step, donate_argnums=(0,) if donate else ())
 
     if params.hide_comm:
         overlapped = hide_communication(update, radius=1)

@@ -164,3 +164,28 @@ def test_fused_requires_deep_halo():
     with pytest.raises(ValueError, match="deep halo"):
         diffusion3d.make_multi_step(params, 4, fused_k=2)
     igg.finalize_global_grid()
+
+
+def test_exchange_cadence_matches_per_step():
+    """Deep-halo cadence on the XLA path: w steps + one width-w slab exchange
+    must be bit-identical to per-step exchange at group boundaries."""
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    state, params = diffusion3d.setup(10, 10, 10, **kw)
+    step = diffusion3d.make_multi_step(params, 4, donate=False)
+    T_ref = np.asarray(igg.gather(jax.block_until_ready(step(*state))[0]))
+    igg.finalize_global_grid()
+
+    state, params = diffusion3d.setup(10, 10, 10, **kw)
+    step2 = diffusion3d.make_multi_step(params, 4, donate=False, exchange_every=2)
+    T_cad = np.asarray(igg.gather(jax.block_until_ready(step2(*state))[0]))
+    igg.finalize_global_grid()
+    np.testing.assert_array_equal(T_cad, T_ref)
+
+
+def test_exchange_cadence_validation():
+    state, params = diffusion3d.setup(10, 10, 10, quiet=True)  # overlap 2
+    with pytest.raises(ValueError, match="deep halo"):
+        diffusion3d.make_multi_step(params, 4, exchange_every=2)
+    with pytest.raises(ValueError, match="multiple of exchange_every"):
+        diffusion3d.make_multi_step(params, 5, exchange_every=2)
+    igg.finalize_global_grid()
