@@ -144,7 +144,7 @@ def _emit(name, teff, t_it, extra=None, emit=True):
 
 def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
                     devices=None, emit=True, fused_k=None, fused_tile=None,
-                    force_spmd=False):
+                    exchange_every=1, overlap=None, force_spmd=False):
     """Benchmarks run with ``donate=False``: buffer donation costs ~3x on the
     tunneled single-chip backend used for the round measurements (measured:
     375 -> 119 GB/s at 256^3 f32; identical HLO, runtime-side penalty), and
@@ -160,12 +160,16 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
+    okw = {} if overlap is None else dict(
+        overlapx=overlap, overlapy=overlap, overlapz=overlap
+    )
     state, params = diffusion3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
-        devices=devices, force_spmd=force_spmd,
+        devices=devices, force_spmd=force_spmd, **okw,
     )
     step = diffusion3d.make_multi_step(
-        params, chunk, donate=False, fused_k=fused_k, fused_tile=fused_tile
+        params, chunk, donate=False, fused_k=fused_k, fused_tile=fused_tile,
+        exchange_every=exchange_every,
     )
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
@@ -174,7 +178,8 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
     return _emit(
         f"diffusion3d_{n}_{dtype}"
         + ("_overlap" if hide_comm else "")
-        + (f"_fused{fused_k}" if fused_k else ""),
+        + (f"_fused{fused_k}" if fused_k else "")
+        + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
         {"dims": list(gg.dims), "nprocs": gg.nprocs},
@@ -183,7 +188,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
 
 
 def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None,
-                   emit=True):
+                   emit=True, exchange_every=1, overlap=None):
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -191,17 +196,24 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
+    okw = {} if overlap is None else dict(
+        overlapx=overlap, overlapy=overlap, overlapz=overlap
+    )
     state, params = acoustic3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
-        devices=devices,
+        devices=devices, **okw,
     )
-    step = acoustic3d.make_multi_step(params, chunk, donate=False)
+    step = acoustic3d.make_multi_step(
+        params, chunk, donate=False, exchange_every=exchange_every
+    )
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize  # P,Vx,Vy,Vz in+out
     return _emit(
-        f"acoustic3d_{n}_{dtype}" + ("_overlap" if hide_comm else ""),
+        f"acoustic3d_{n}_{dtype}"
+        + ("_overlap" if hide_comm else "")
+        + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
         {"dims": list(gg.dims), "nprocs": gg.nprocs},
@@ -297,12 +309,20 @@ def main():
     p.add_argument("--npt", type=int, default=10)
     p.add_argument("--fused-k", type=int, default=None,
                    help="temporally-blocked Pallas kernel: k steps per HBM pass")
+    p.add_argument("--exchange-every", type=int, default=1,
+                   help="XLA slab cadence: w steps per width-w halo exchange "
+                        "(needs a deep-halo grid: --overlap >= 2w)")
+    p.add_argument("--overlap", type=int, default=None,
+                   help="grid overlap in every dimension (deep halos for "
+                        "--fused-k/--exchange-every on communicating grids)")
     a = p.parse_args()
     kw = dict(chunk=a.chunk, reps=a.reps, dtype=a.dtype)
     if a.what in ("diffusion", "all"):
-        bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k, **kw)
+        bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k,
+                        exchange_every=a.exchange_every, overlap=a.overlap, **kw)
     if a.what in ("acoustic", "all"):
-        bench_acoustic(n=a.n or 192, hide_comm=a.hide_comm, **kw)
+        bench_acoustic(n=a.n or 192, hide_comm=a.hide_comm,
+                       exchange_every=a.exchange_every, overlap=a.overlap, **kw)
     if a.what in ("porous", "all"):
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly

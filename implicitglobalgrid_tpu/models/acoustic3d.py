@@ -19,11 +19,14 @@ Update (explicit leapfrog):
     V  -= dt/rho * grad(P)      (interior face points)
     P  -= dt*K   * div(V)       (all cell centers)
 
-Only the velocity fields exchange halos: ``P`` is recomputed everywhere from
-post-exchange velocities, so its boundary planes are always fresh — one
-3-field `update_halo` per step instead of four.  With ``hide_comm=True`` the
-exchange of the velocity slabs overlaps the interior velocity update
-(`hide_communication`), the reference's `@hide_communication` capability.
+On the per-step path only the velocity fields exchange halos: ``P`` is
+recomputed everywhere from post-exchange velocities, so its boundary planes
+are always fresh — one 3-field `update_halo` per step instead of four.  (The
+``exchange_every`` slab cadence in `make_multi_step` is the exception: there
+``P``'s rind goes stale between exchanges and all FOUR fields are
+slab-exchanged.)  With ``hide_comm=True`` the exchange of the velocity slabs
+overlaps the interior velocity update (`hide_communication`), the
+reference's `@hide_communication` capability.
 """
 
 from __future__ import annotations
@@ -200,7 +203,7 @@ def make_multi_step(
     if exchange_every < 1:
         raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")
     if exchange_every > 1:
-        from ..parallel.grid import global_grid
+        from ..ops.halo import require_deep_halo
 
         if params.hide_comm:
             raise ValueError(
@@ -212,20 +215,7 @@ def make_multi_step(
             raise ValueError(
                 f"nsteps={nsteps} must be a multiple of exchange_every={exchange_every}"
             )
-        gg = global_grid()
-        shallow = [
-            d
-            for d in range(3)
-            if (gg.dims[d] > 1 or gg.periods[d])
-            and gg.overlaps[d] < 2 * exchange_every
-        ]
-        if shallow:
-            raise ValueError(
-                f"exchange_every={exchange_every} needs a deep halo: overlap >= "
-                f"{2 * exchange_every} in every dimension with halo activity, "
-                f"but dims {shallow} have overlaps "
-                f"{[gg.overlaps[d] for d in shallow]}."
-            )
+        require_deep_halo(exchange_every)
         w = exchange_every
 
         def block_step(P, Vx, Vy, Vz):

@@ -247,20 +247,12 @@ def make_multi_step(
             )
         import jax
 
+        from ..ops.halo import require_deep_halo
+
+        require_deep_halo(fused_k, gg, what="fused_k")
         active = [
             d for d in range(3) if gg.dims[d] > 1 or gg.periods[d]
         ]
-        shallow = [d for d in active if gg.overlaps[d] < 2 * fused_k]
-        if shallow:
-            raise ValueError(
-                f"fused_k={fused_k} on a communicating grid needs a deep halo: "
-                f"overlap >= {2 * fused_k} in every dimension with halo "
-                f"activity, but dims {shallow} have overlaps "
-                f"{[gg.overlaps[d] for d in shallow]} (grid dims={gg.dims}, "
-                f"periods={gg.periods}). Re-init with overlap"
-                f"{'/'.join('xyz'[d] for d in shallow)}={2 * fused_k}, or use "
-                "the XLA path (one exchange per step)."
-            )
         cx = params.dt * params.lam / (params.dx * params.dx)
         cy = params.dt * params.lam / (params.dy * params.dy)
         cz = params.dt * params.lam / (params.dz * params.dz)
@@ -300,7 +292,7 @@ def make_multi_step(
     if exchange_every < 1:
         raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")
     if exchange_every > 1:
-        from ..parallel.grid import global_grid
+        from ..ops.halo import require_deep_halo
 
         if params.hide_comm:
             raise ValueError(
@@ -312,20 +304,7 @@ def make_multi_step(
             raise ValueError(
                 f"nsteps={nsteps} must be a multiple of exchange_every={exchange_every}"
             )
-        gg = global_grid()
-        shallow = [
-            d
-            for d in range(3)
-            if (gg.dims[d] > 1 or gg.periods[d])
-            and gg.overlaps[d] < 2 * exchange_every
-        ]
-        if shallow:
-            raise ValueError(
-                f"exchange_every={exchange_every} needs a deep halo: overlap >= "
-                f"{2 * exchange_every} in every dimension with halo activity, "
-                f"but dims {shallow} have overlaps "
-                f"{[gg.overlaps[d] for d in shallow]}."
-            )
+        require_deep_halo(exchange_every)
         w = exchange_every
 
         def block_step(T, Cp):

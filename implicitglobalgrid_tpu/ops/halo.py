@@ -147,6 +147,33 @@ def check_fields(fields, gg) -> None:
         )
 
 
+def require_deep_halo(w: int, gg=None, *, what: str = "exchange_every") -> None:
+    """Validate that every dimension with halo activity has ``overlap >= 2w``.
+
+    Shared precondition of the temporal-blocking cadences
+    (`update_halo(width=w)` once per ``w`` steps — the fused-kernel and
+    XLA-only variants in the models): the sent slab planes must lie at
+    distance >= ``w`` from the block edge, where ``w`` stencil steps are
+    still exact.  Raises ``ValueError`` naming the shallow dimensions.
+    """
+    if gg is None:
+        gg = _grid.global_grid()
+    shallow = [
+        d
+        for d in range(NDIMS)
+        if (gg.dims[d] > 1 or gg.periods[d]) and gg.overlaps[d] < 2 * w
+    ]
+    if shallow:
+        raise ValueError(
+            f"{what}={w} on a communicating grid needs a deep halo: overlap >= "
+            f"{2 * w} in every dimension with halo activity, but dims {shallow} "
+            f"have overlaps {[gg.overlaps[d] for d in shallow]} (grid dims="
+            f"{gg.dims}, periods={gg.periods}). Re-init with overlap"
+            f"{'/'.join('xyz'[d] for d in shallow)}={2 * w}, or use the "
+            "per-step exchange."
+        )
+
+
 def _set_plane(A, plane, index: int, dim: int):
     import jax.numpy as jnp
     from jax import lax
