@@ -189,3 +189,40 @@ def test_exchange_cadence_validation():
     with pytest.raises(ValueError, match="multiple of exchange_every"):
         diffusion3d.make_multi_step(params, 5, exchange_every=2)
     igg.finalize_global_grid()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_topology_decomposition_invariance(seed):
+    """End-to-end oracle across random topologies: a multi-block run must
+    reproduce the single-device run of the same global problem exactly,
+    whatever dims/overlap are drawn.  Non-periodic only — on periodic dims
+    the implicit global size drops the +overlap term and the duplicated
+    cells wrap, so the single-device problem is not the simple dedup; the
+    halo-level sweeps in test_update_halo carry the periodic coverage."""
+    rng = np.random.default_rng(7000 + seed)
+    o = int(rng.integers(2, 5))
+    nx = int(rng.integers(2 * o + 2, 2 * o + 6))
+    overlaps = {f"overlap{ax}": o for ax in "xyz"}
+    nt = int(rng.integers(3, 8))
+
+    state, params = diffusion3d.setup(nx, nx, nx, quiet=True, **overlaps)
+    gg = igg.get_global_grid()
+    dims = gg.dims
+    step = diffusion3d.make_step(params)
+    for _ in range(nt):
+        state = jax.block_until_ready(step(*state))
+    T_multi = dedup_global(
+        np.asarray(igg.gather(state[0])), dims, (nx,) * 3, (o,) * 3
+    )
+    igg.finalize_global_grid()
+
+    nxg = tuple(dims[d] * (nx - o) + o for d in range(3))
+    state, params = diffusion3d.setup(
+        *nxg, devices=[jax.devices()[0]], quiet=True
+    )
+    step = diffusion3d.make_step(params)
+    for _ in range(nt):
+        state = jax.block_until_ready(step(*state))
+    T_single = np.asarray(igg.gather(state[0]))
+    igg.finalize_global_grid()
+    np.testing.assert_allclose(T_multi, T_single, rtol=1e-12, atol=1e-12)
