@@ -192,8 +192,9 @@ def make_multi_step(
     ALL four fields in one collective call — unlike the per-step path, the
     incrementally-updated ``P`` must be exchanged too (its stale rind is
     never recomputed from fresh velocities, so the slab replaces it with the
-    neighbor's still-exact planes).  One collective per ``w`` steps;
-    bit-identical states at group boundaries.
+    neighbor's still-exact planes).  One collective per ``w`` steps; states
+    at group boundaries identical up to compiler fusion rounding (bitwise on
+    the CPU mesh; few f32 ULPs on TPU).
     """
     from jax import lax
 

@@ -205,9 +205,11 @@ def make_multi_step(
     ``exchange_every=w`` (XLA path): on a deep-halo grid (``overlap >= 2w``
     in every dimension with halo activity) run ``w`` stencil steps between
     halo exchanges and exchange a width-``w`` slab — one collective per
-    ``w`` steps, bit-identical results at group boundaries (the w-deep stale
-    rind each block accumulates is exactly the slab the exchange replaces
-    with the neighbor's still-exact planes).  The latency-amortization half
+    ``w`` steps, results at group boundaries identical up to compiler
+    fusion rounding (bitwise on the CPU mesh; few f32 ULPs on TPU, where
+    differently-fused programs contract FMAs differently) — the w-deep
+    stale rind each block accumulates is exactly the slab the exchange
+    replaces with the neighbor's still-exact planes.  The latency-amortization half
     of the deep-halo story without the Pallas kernel; combine with
     ``fused_k=w`` to also amortize HBM traffic.
 
