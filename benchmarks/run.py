@@ -221,7 +221,11 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
     )
 
 
-def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None, emit=True):
+def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
+                 emit=True, exchange_every=1, overlap=None):
+    """``chunk`` whole time steps (= ``chunk*npt`` PT iterations) per call via
+    `porous_convection3d.make_multi_step` — one XLA program, like the other
+    models' production paths."""
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -229,24 +233,25 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None, 
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
-    state, params = pc.setup(
-        n, n, n, dtype=jax.numpy.dtype(dtype), npt=npt, quiet=True, devices=devices
+    okw = {} if overlap is None else dict(
+        overlapx=overlap, overlapy=overlap, overlapz=overlap
     )
-    step = pc.make_step(params, donate=False)
-
-    def multi(*s):
-        for _ in range(chunk):
-            s = step(*s)
-        return s
-
-    t_step, state = _time_steps(multi, state, chunk, reps)
+    state, params = pc.setup(
+        n, n, n, dtype=jax.numpy.dtype(dtype), npt=npt, quiet=True, devices=devices,
+        **okw,
+    )
+    step = pc.make_multi_step(
+        params, chunk, donate=False, exchange_every=exchange_every
+    )
+    t_step, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     # Per PT iteration: qDx,qDy,qDz,Pf in+out = 8 array passes.
     t_pt = t_step / npt
     nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize
     return _emit(
-        f"porous_convection3d_{n}_{dtype}_npt{npt}",
+        f"porous_convection3d_{n}_{dtype}_npt{npt}"
+        + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_pt / 1e9,
         t_step,
         {"dims": list(gg.dims), "nprocs": gg.nprocs, "t_pt_ms": round(t_pt * 1e3, 4)},
@@ -327,7 +332,8 @@ def main():
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly
         porous_chunk = a.chunk if a.what == "porous" else 4
-        bench_porous(n=a.n or 128, chunk=porous_chunk, reps=a.reps, npt=a.npt, dtype=a.dtype)
+        bench_porous(n=a.n or 128, chunk=porous_chunk, reps=a.reps, npt=a.npt,
+                     dtype=a.dtype, exchange_every=a.exchange_every, overlap=a.overlap)
     if a.what in ("weak", "all"):
         bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,
                            dtype=a.dtype, hide_comm=a.hide_comm)

@@ -12,6 +12,15 @@ it TPU-first:
   the whole inner solver is ONE XLA program with a halo exchange per
   iteration, the communication pattern that dominates the reference's
   weak-scaling benchmark.
+* **Comm-lean exchange**: the per-iteration exchange is the PRESSURE (one
+  cell field), not the three staggered fluxes — the fluxes at every interior
+  face are recomputable from post-exchange ``Pf`` (plus their own local
+  relaxation history, which never crosses the block edge), so one plane per
+  side per dimension crosses the wire instead of three.  3x less
+  communication volume per PT iteration than the flux-exchange formulation
+  on the same grid; a single 3-field flux exchange at the end of the PT loop
+  restores the all-duplicated-cells-agree invariant for the frozen face
+  rings (gather/visualization contract).
 * **Staggered fields**: Darcy fluxes live on cell faces (``n+1`` shapes).
 * **Buoyancy** (Boussinesq): ``qD = -k/eta * (grad(Pf) - Ra_hat * T * e_z)``.
 * **Temperature**: explicit upwind advection + diffusion, interior update +
@@ -139,24 +148,19 @@ def setup(
     return (T, Pf, qDx, qDy, qDz), params
 
 
-def _pt_iteration(params: Params):
-    """One pseudo-transient Darcy relaxation: flux update (+buoyancy), halo
-    exchange of the fluxes, pressure update.  Pf needs no exchange — it is
-    recomputed at every cell from post-exchange fluxes (same argument as the
-    acoustic model's pressure).  With ``params.hide_comm`` the flux exchange
-    overlaps the interior flux update (`hide_communication`), mirroring the
-    acoustic model's velocity phase."""
+def _flux_update(params: Params):
+    """Pure per-block Darcy flux relaxation (no exchange): interior faces only
+    (padded-delta form — boundary faces frozen, the no-flow walls)."""
     import jax.numpy as jnp
 
     th = params.theta_q
-    bp = params.beta_p
     dx, dy, dz = params.dx, params.dy, params.dz
 
     def av_z_to_face(T):
         # T averaged onto interior z-faces: (nx-2, ny-2, nz-1)
         return 0.5 * (T[1:-1, 1:-1, 1:] + T[1:-1, 1:-1, :-1])
 
-    def flux_update(T, Pf, qDx, qDy, qDz):
+    def update(T, Pf, qDx, qDy, qDz):
         # Darcy flux relaxation toward -grad(Pf) + Ra*T e_z (interior faces).
         fx = -jnp.diff(Pf[:, 1:-1, 1:-1], axis=0) / dx
         fy = -jnp.diff(Pf[1:-1, :, 1:-1], axis=1) / dy
@@ -166,25 +170,60 @@ def _pt_iteration(params: Params):
         qDz = qDz + jnp.pad(th * (fz - _inn(qDz)), 1)
         return qDx, qDy, qDz
 
-    if params.hide_comm:
-        overlapped = hide_communication(flux_update, radius=1)
+    return update
 
-        def fluxes_exchanged(T, Pf, qDx, qDy, qDz):
-            return overlapped(T, Pf, qDx, qDy, qDz)
 
-    else:
+def _pressure_update(params: Params):
+    """Pure per-block pressure relaxation: all cells, from fresh fluxes.
 
-        def fluxes_exchanged(T, Pf, qDx, qDy, qDz):
-            return update_halo(*flux_update(T, Pf, qDx, qDy, qDz))
+    At global walls the frozen boundary faces carry flux 0, so the outermost
+    cells evolve under the physical no-flow condition; at block-internal
+    edges the same expression writes garbage into the halo cells (stale
+    frozen faces), which the Pf exchange overwrites with the neighbor's
+    interior values — the standard recompute-then-exchange pattern."""
+    import jax.numpy as jnp
 
-    def iteration(T, Pf, qDx, qDy, qDz):
-        qDx, qDy, qDz = fluxes_exchanged(T, Pf, qDx, qDy, qDz)
+    bp = params.beta_p
+    dx, dy, dz = params.dx, params.dy, params.dz
+
+    def update(Pf, qDx, qDy, qDz):
         div = (
             jnp.diff(qDx, axis=0) / dx
             + jnp.diff(qDy, axis=1) / dy
             + jnp.diff(qDz, axis=2) / dz
         )
-        Pf = Pf - bp * div
+        return Pf - bp * div
+
+    return update
+
+
+def _pt_iteration(params: Params):
+    """One pseudo-transient Darcy relaxation: flux update (+buoyancy) on
+    interior faces, pressure update at all cells, halo exchange of ``Pf``
+    (ONE field — see the module docstring's comm-lean design note; the
+    reference's analogue exchanges every relaxed field per iteration,
+    `/root/reference/src/update_halo.jl:25-78` applied in its miniapp loops).
+    The fluxes need no per-iteration exchange: their interior faces are
+    recomputed each iteration from post-exchange ``Pf`` halos and their own
+    (purely local) relaxation history.  With ``params.hide_comm`` the ``Pf``
+    exchange overlaps the interior pressure update (`hide_communication`)."""
+    flux_update = _flux_update(params)
+    p_update = _pressure_update(params)
+
+    if params.hide_comm:
+        overlapped_p = hide_communication(p_update, radius=1)
+
+        def pressure_exchanged(Pf, qDx, qDy, qDz):
+            return overlapped_p(Pf, qDx, qDy, qDz)
+
+    else:
+
+        def pressure_exchanged(Pf, qDx, qDy, qDz):
+            return update_halo(p_update(Pf, qDx, qDy, qDz))
+
+    def iteration(T, Pf, qDx, qDy, qDz):
+        qDx, qDy, qDz = flux_update(T, Pf, qDx, qDy, qDz)
+        Pf = pressure_exchanged(Pf, qDx, qDy, qDz)
         return Pf, qDx, qDy, qDz
 
     return iteration
@@ -235,8 +274,11 @@ def _temperature_update(params: Params):
 def make_step(params: Params, *, donate: bool = True):
     """One time step: ``npt`` PT pressure iterations (fori_loop) + T update.
 
-    The inner loop, its per-iteration 3-field halo exchange, the temperature
-    update and its exchange compile into one XLA program per block.
+    The inner loop, its per-iteration ``Pf`` exchange, the once-per-step
+    3-field flux exchange (which refreshes only the frozen face rings — the
+    interior faces are already exact — restoring the duplicated-cells-agree
+    invariant for gather/visualization), the temperature update and its
+    exchange compile into one XLA program per block.
     """
     from jax import lax
 
@@ -250,12 +292,105 @@ def make_step(params: Params, *, donate: bool = True):
             return pt_iter(T, Pf, qDx, qDy, qDz)
 
         Pf, qDx, qDy, qDz = lax.fori_loop(0, npt, body, (Pf, qDx, qDy, qDz))
+        qDx, qDy, qDz = update_halo(qDx, qDy, qDz)
         T = t_update(T, qDx, qDy, qDz)
         T = update_halo(T)
         return T, Pf, qDx, qDy, qDz
 
     donate_argnums = tuple(range(5)) if donate else ()
     return stencil(block_step, donate_argnums=donate_argnums)
+
+
+def make_multi_step(
+    params: Params,
+    nsteps: int,
+    *,
+    donate: bool = True,
+    exchange_every: int = 1,
+):
+    """Advance ``nsteps`` time steps per call in ONE XLA program
+    (`lax.fori_loop` over whole time steps) — the production path: per-call
+    dispatch amortizes over ``nsteps * npt`` PT iterations, the
+    communication pattern of the reference's weak-scaling headline
+    (`/root/reference/README.md:6-8`).
+
+    ``exchange_every=w`` (deep-halo grids, ``overlap >= 2w``): the PT inner
+    loop runs ``w`` relaxation iterations between exchanges and then
+    slab-exchanges ALL FOUR PT fields (``Pf`` + fluxes, width ``w``) in one
+    collective call — unlike the per-iteration path, the fluxes' relaxation
+    history goes stale in the rind between exchanges (each unexchanged
+    iteration contaminates one more ring of both ``Pf`` and ``q``), so the
+    slab must replace the fluxes' stale rind too, exactly like the acoustic
+    cadence exchanges its incrementally-updated ``P``.  One collective per
+    ``w`` PT iterations; owned-cell results bitwise identical to the
+    per-iteration path on the CPU mesh (few f32 ULPs on TPU, where
+    differently-fused programs round differently).  Requires
+    ``npt % w == 0``.
+    """
+    from jax import lax
+
+    pt_iter = _pt_iteration(params)
+    t_update = _temperature_update(params)
+    flux_update = _flux_update(params)
+    p_update = _pressure_update(params)
+    npt = params.npt
+
+    if exchange_every < 1:
+        raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")
+    if exchange_every > 1:
+        from ..ops.halo import require_deep_halo
+
+        if params.hide_comm:
+            raise ValueError(
+                "exchange_every and hide_comm are mutually exclusive: overlap "
+                "scheduling hides the per-iteration exchange; a slab cadence "
+                "replaces it."
+            )
+        if npt % exchange_every != 0:
+            raise ValueError(
+                f"npt={npt} must be a multiple of exchange_every={exchange_every}"
+            )
+        require_deep_halo(exchange_every)
+        w = exchange_every
+
+        def block_step(T, Pf, qDx, qDy, qDz):
+            def group(i, s):
+                def body(j, s):
+                    Pf, qDx, qDy, qDz = s
+                    qDx, qDy, qDz = flux_update(T, Pf, qDx, qDy, qDz)
+                    Pf = p_update(Pf, qDx, qDy, qDz)
+                    return (Pf, qDx, qDy, qDz)
+
+                Pf, qDx, qDy, qDz = lax.fori_loop(0, w, body, s)
+                return update_halo(Pf, qDx, qDy, qDz, width=w)
+
+            Pf, qDx, qDy, qDz = lax.fori_loop(
+                0, npt // w, group, (Pf, qDx, qDy, qDz)
+            )
+            T = t_update(T, qDx, qDy, qDz)
+            T = update_halo(T)
+            return T, Pf, qDx, qDy, qDz
+
+    else:
+
+        def block_step(T, Pf, qDx, qDy, qDz):
+            def body(i, s):
+                Pf, qDx, qDy, qDz = s
+                return pt_iter(T, Pf, qDx, qDy, qDz)
+
+            Pf, qDx, qDy, qDz = lax.fori_loop(0, npt, body, (Pf, qDx, qDy, qDz))
+            qDx, qDy, qDz = update_halo(qDx, qDy, qDz)
+            T = t_update(T, qDx, qDy, qDz)
+            T = update_halo(T)
+            return T, Pf, qDx, qDy, qDz
+
+    def multi(T, Pf, qDx, qDy, qDz):
+        return lax.fori_loop(
+            0, nsteps, lambda i, s: block_step(*s), (T, Pf, qDx, qDy, qDz)
+        )
+
+    donate_argnums = tuple(range(5)) if donate else ()
+    return stencil(multi, donate_argnums=donate_argnums)
 
 
 def run(nt: int, nx: int = 32, ny: int = 32, nz: int = 32, *, finalize: bool = True, **kw):

@@ -1,6 +1,7 @@
 """Porous-convection model tests (pseudo-transient Darcy + temperature)."""
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -92,6 +93,61 @@ def test_pt_solver_converges_and_bound_is_sharp():
         assert not np.isfinite(r_bad) or r_bad > 1e6  # diverges, not "slow"
     finally:
         igg.finalize_global_grid()
+
+
+def test_multi_step_matches_single_steps():
+    """The production chunk path (nsteps per XLA program) must reproduce the
+    per-step path exactly."""
+    nx, nt = 10, 3
+    state, params = pc.setup(nx, nx, nx, npt=6)
+    step = pc.make_step(params, donate=False)
+    multi = pc.make_multi_step(params, nt, donate=False)
+    s1 = state
+    for _ in range(nt):
+        s1 = jax.block_until_ready(step(*s1))
+    s3 = jax.block_until_ready(multi(*state))
+    for a, b, name in zip(s1, s3, ("T", "Pf", "qDx", "qDy", "qDz")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-12, atol=1e-13, err_msg=name
+        )
+    igg.finalize_global_grid()
+
+
+def test_pt_cadence_matches_per_iteration():
+    """Deep-halo PT cadence: w relaxation iterations + one width-w 4-field
+    slab exchange must be bit-identical to the per-iteration Pf exchange at
+    group boundaries (owned cells)."""
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True, npt=6)
+    nx, nt = 12, 2
+
+    def _run_cadence(exchange_every):
+        state, params = pc.setup(nx, nx, nx, **kw)
+        gg = igg.get_global_grid()
+        dims, o = gg.dims, gg.overlaps
+        step = pc.make_multi_step(params, nt, donate=False, exchange_every=exchange_every)
+        state = jax.block_until_ready(step(*state))
+        out = {}
+        for name, A in zip(("T", "Pf", "qDx", "qDy", "qDz"), state):
+            shp = igg.local_shape(A)
+            ol = tuple(igg.ol(d, A) for d in range(3))
+            g = np.asarray(igg.gather(A))
+            out[name] = dedup_global(g, dims, shp, ol) if max(dims) > 1 else g
+        igg.finalize_global_grid()
+        return out
+
+    ref = _run_cadence(1)
+    cad = _run_cadence(2)
+    for k in ref:
+        np.testing.assert_array_equal(cad[k], ref[k], err_msg=k)
+
+
+def test_pt_cadence_validation():
+    state, params = pc.setup(10, 10, 10, npt=6, quiet=True)  # overlap 2
+    with pytest.raises(ValueError, match="deep halo"):
+        pc.make_multi_step(params, 2, exchange_every=2)
+    with pytest.raises(ValueError, match="multiple of exchange_every"):
+        pc.make_multi_step(params, 2, exchange_every=4)
+    igg.finalize_global_grid()
 
 
 def test_convection_starts_and_is_bounded():
