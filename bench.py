@@ -60,18 +60,34 @@ def main():
     extras = {"diffusion_xla": {"teff": rec["value"], "t_it_ms": rec["t_it_ms"]}}
 
     def _extra(name, fn):
-        # Per-config isolation: one failing extra (e.g. the Pallas kernel on
-        # a non-TPU backend) must not discard the remaining configs.
+        # Per-config isolation: one crashing extra (e.g. a backend compile
+        # fault) must not discard the remaining configs.  Shape-level kernel
+        # rejection no longer lands here: make_multi_step(fused_k=...) falls
+        # back to the XLA cadence on its own (warn-once), and the recorded
+        # "path" says which one actually ran.
         try:
             extras[name] = fn()
         except Exception as e:
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
 
+    def _fused_record(r, n, k, tile=(None, None)):
+        # Deterministic provenance: the same envelope check the fallback
+        # uses (single-chip bench => local block == n^3 float32), not a
+        # warn-once side channel that a second same-config build would miss.
+        from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
+
+        err = fused_support_error((n, n, n), k, 4, *tile)
+        return {
+            "teff": r["value"],
+            "t_it_ms": r["t_it_ms"],
+            "path": "pallas-fused" if err is None else "xla-fallback",
+        }
+
     def _fused():
         r = _bench.bench_diffusion(
             n=256, chunk=24, reps=4, dtype="float32", emit=False, fused_k=4
         )
-        return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
+        return _fused_record(r, 256, 4)
 
     def _fused512():
         # BASELINE config 5's per-chip problem size (512^3/chip).  The XLA
@@ -83,7 +99,7 @@ def main():
             n=512, chunk=24, reps=3, dtype="float32", emit=False, fused_k=4,
             fused_tile=(32, 128),
         )
-        return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
+        return _fused_record(r, 512, 4, (32, 128))
 
     def _overlap():
         r = _bench.bench_diffusion(
@@ -122,8 +138,17 @@ def main():
     _extra("acoustic_overlap", _acoustic_overlap)
     _extra("porous_pt", _porous)
     best = rec["value"]
+    extras["headline_path"] = "xla"
     fused = extras.get("diffusion_pallas_fused4", {})
-    best = max(best, fused.get("teff", 0.0))
+    # The headline is the faster production path whatever it was (the fused
+    # config may itself have auto-fallen-back to the XLA cadence); the
+    # recorded path makes the provenance unambiguous (advisor round 2).
+    if fused.get("teff", 0.0) > best:
+        best = fused["teff"]
+        extras["headline_path"] = (
+            "pallas_fused4" if fused.get("path") == "pallas-fused"
+            else "xla_fallback_cadence"
+        )
     print(
         json.dumps(
             {

@@ -71,6 +71,25 @@ def _inn(A):
     return A[1:-1, 1:-1, 1:-1]
 
 
+_fused_fallback_warned: set = set()
+
+
+def _warn_fused_fallback(shape, k, err) -> None:
+    """Warn once per (shape, k, reason) that fused_k fell back to XLA."""
+    import warnings
+
+    key = (shape, k, err)
+    if key in _fused_fallback_warned:
+        return
+    _fused_fallback_warned.add(key)
+    warnings.warn(
+        f"fused_k={k} is unsupported for local block shape {shape} ({err}); "
+        "falling back to the XLA path at the same exchange cadence.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _gaussians(X, Y, Z, params: Params, jnp):
     """The reference's two pairs of Gaussian anomalies (lines :34-37)."""
     lx, ly, lz = params.lx, params.ly, params.lz
@@ -231,7 +250,7 @@ def make_multi_step(
 
     if fused_k:
         from ..parallel.grid import global_grid
-        from ..ops.pallas_stencil import fused_diffusion_steps
+        from ..ops.pallas_stencil import fused_diffusion_steps, fused_support_error
 
         gg = global_grid()
         if params.hide_comm:
@@ -252,13 +271,33 @@ def make_multi_step(
         from ..ops.halo import require_deep_halo
 
         require_deep_halo(fused_k, gg, what="fused_k")
-        active = [
-            d for d in range(3) if gg.dims[d] > 1 or gg.periods[d]
-        ]
+        from ..ops.halo import dim_has_halo_activity
+
+        active = [d for d in range(3) if dim_has_halo_activity(gg, d)]
+        update = _diffusion_update(params)
         cx = params.dt * params.lam / (params.dx * params.dx)
         cy = params.dt * params.lam / (params.dy * params.dy)
         cz = params.dt * params.lam / (params.dz * params.dz)
         bx, by = fused_tile if fused_tile is not None else (None, None)
+        if (bx is None) != (by is None):
+            # A half-specified tile is a caller error, not a shape the kernel
+            # cannot run — raise eagerly rather than warn-and-fall-back.
+            raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
+
+        # Shapes are only known at trace time, so the kernel-vs-fallback
+        # choice happens there: a local block the kernel's envelope rejects
+        # warns once and runs the XLA path at the SAME exchange cadence
+        # (w steps per width-w slab exchange — the deep halo is already
+        # validated above), the reference's runtime-path-selection move
+        # (`/root/reference/src/update_halo.jl:755-784`).
+        def fused_or_fallback(T, Cp, fused_body, xla_body):
+            err = fused_support_error(
+                tuple(T.shape), fused_k, T.dtype.itemsize, bx, by
+            )
+            if err is None:
+                return fused_body(T, Cp)
+            _warn_fused_fallback(tuple(T.shape), fused_k, err)
+            return xla_body(T, Cp)
 
         if not active:
 
@@ -266,13 +305,19 @@ def make_multi_step(
                 def body(i, T):
                     return fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
 
-                T = lax.fori_loop(0, nsteps // fused_k, body, T)
-                return T, Cp
+                return lax.fori_loop(0, nsteps // fused_k, body, T), Cp
+
+            def xla_chunk(T, Cp):
+                # No halo activity: the exchange is a no-op, plain steps.
+                return lax.fori_loop(0, nsteps, lambda i, T: update(T, Cp), T), Cp
 
             # No halo activity means no collectives: skip the shard_map
             # wrapper and jit directly (fields are committed to the grid's
             # single device).
-            return jax.jit(fused_chunk, donate_argnums=(0,) if donate else ())
+            return jax.jit(
+                lambda T, Cp: fused_or_fallback(T, Cp, fused_chunk, xla_chunk),
+                donate_argnums=(0,) if donate else (),
+            )
 
         def fused_block_step(T, Cp):
             def body(i, T):
@@ -284,10 +329,19 @@ def make_multi_step(
                 # where k kernel steps are still exact.
                 return update_halo(T, width=fused_k)
 
-            T = lax.fori_loop(0, nsteps // fused_k, body, T)
-            return T, Cp
+            return lax.fori_loop(0, nsteps // fused_k, body, T), Cp
 
-        return stencil(fused_block_step, donate_argnums=(0,) if donate else ())
+        def xla_cadence_step(T, Cp):
+            def group(i, T):
+                T = lax.fori_loop(0, fused_k, lambda j, T: update(T, Cp), T)
+                return update_halo(T, width=fused_k)
+
+            return lax.fori_loop(0, nsteps // fused_k, group, T), Cp
+
+        return stencil(
+            lambda T, Cp: fused_or_fallback(T, Cp, fused_block_step, xla_cadence_step),
+            donate_argnums=(0,) if donate else (),
+        )
 
     update = _diffusion_update(params)
 

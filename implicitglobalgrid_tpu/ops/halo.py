@@ -147,6 +147,19 @@ def check_fields(fields, gg) -> None:
         )
 
 
+def dim_has_halo_activity(gg, d: int) -> bool:
+    """Whether dimension ``d`` exchanges anything at all on this grid.
+
+    Periodic dimensions always have partners (possibly self via the
+    Cart_shift wrap); non-periodic ones only when the distance-``disp``
+    shift stays on the grid for some block — ``abs(disp) >= dims[d]`` makes
+    every partner PROC_NULL (and ``disp == 0`` a self-partner).
+    """
+    if gg.periods[d]:
+        return True
+    return abs(int(gg.disp)) < gg.dims[d]
+
+
 def require_deep_halo(w: int, gg=None, *, what: str = "exchange_every") -> None:
     """Validate that every dimension with halo activity has ``overlap >= 2w``.
 
@@ -155,13 +168,19 @@ def require_deep_halo(w: int, gg=None, *, what: str = "exchange_every") -> None:
     XLA-only variants in the models): the sent slab planes must lie at
     distance >= ``w`` from the block edge, where ``w`` stencil steps are
     still exact.  Raises ``ValueError`` naming the shallow dimensions.
+
+    This is a *grid-level* precheck against ``gg.overlaps`` for an early,
+    caller-facing error at build time; the authoritative per-field check is
+    the shape-aware ``ol`` validation inside `_exchange_dim`, which a field
+    whose own ``ol`` is below the grid overlap (e.g. an ``n-1``-sized axis)
+    still hits at trace time.
     """
     if gg is None:
         gg = _grid.global_grid()
     shallow = [
         d
         for d in range(NDIMS)
-        if (gg.dims[d] > 1 or gg.periods[d]) and gg.overlaps[d] < 2 * w
+        if dim_has_halo_activity(gg, d) and gg.overlaps[d] < 2 * w
     ]
     if shallow:
         raise ValueError(
@@ -214,8 +233,11 @@ def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
     n = shp[d]
     nd = gg.dims[d]
     periodic = bool(gg.periods[d])
-    if nd == 1 and not periodic:
-        return A  # no neighbors in this dimension
+    disp = int(gg.disp)
+    if not dim_has_halo_activity(gg, d):
+        # No partners at all: dims==1 non-periodic, or every distance-disp
+        # shift falls off the grid (all partners PROC_NULL).
+        return A
     if o < 2 * width:
         # Only dimensions that actually exchange need the deep halo.
         raise ValueError(
@@ -223,8 +245,18 @@ def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
             f"dimension {d}; this field has ol={o}. Re-init the grid with "
             f"overlap{'xyz'[d]}={2 * width} (deep halo) or use width=1."
         )
-    if nd == 1:
-        # Self-neighbor fast path (reference: update_halo.jl:57-63): local copy.
+    # Exchange partners sit at Cartesian distance ``disp`` — the semantics of
+    # the reference's ``MPI_Cart_shift(dim, disp)`` neighbor table
+    # (`/root/reference/src/init_global_grid.jl:89-92`), which its
+    # `update_halo!` sends to (`/root/reference/src/update_halo.jl:713-735`).
+    # The ppermute pairs below realize exactly `GlobalGrid.neighbors`
+    # (`parallel/topology.py:neighbors_table`): send_lo goes to
+    # ``neighbors[0, d]`` (coordinate - disp), send_hi to ``neighbors[1, d]``.
+    partner_self = (disp % nd == 0) if periodic else (disp == 0)
+    if partner_self:
+        # Every block is its own partner (periodic wrap disp%nd==0, the
+        # reference's self-neighbor fast path generalized, or disp==0):
+        # pure local copy (reference: update_halo.jl:57-63).
         lo_send = _get_plane(A, o - width, d, width)
         hi_send = _get_plane(A, n - o, d, width)
         A = _set_plane(A, lo_send, n - width, d)
@@ -232,19 +264,20 @@ def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
         return A
 
     axis = AXIS_NAMES[d]
-    # Slabs go to the lower neighbor's top ``width`` planes / the upper
-    # neighbor's bottom ``width`` planes (reference sendranges/recvranges,
+    # Slabs go to the lower partner's top ``width`` planes / the upper
+    # partner's bottom ``width`` planes (reference sendranges/recvranges,
     # generalized from one plane to a slab).
     send_lo = _get_plane(A, o - width, d, width)
     send_hi = _get_plane(A, n - o, d, width)
-    perm_down = [(i, i - 1) for i in range(1, nd)]
-    perm_up = [(i, i + 1) for i in range(nd - 1)]
     if periodic:
-        perm_down.append((0, nd - 1))
-        perm_up.append((nd - 1, 0))
+        perm_down = [(i, (i - disp) % nd) for i in range(nd)]
+        perm_up = [(i, (i + disp) % nd) for i in range(nd)]
+    else:
+        perm_down = [(i, i - disp) for i in range(nd) if 0 <= i - disp < nd]
+        perm_up = [(i, i + disp) for i in range(nd) if 0 <= i + disp < nd]
     try:
-        recv_hi = lax.ppermute(send_lo, axis, perm_down)  # from my upper neighbor
-        recv_lo = lax.ppermute(send_hi, axis, perm_up)  # from my lower neighbor
+        recv_hi = lax.ppermute(send_lo, axis, perm_down)  # from my upper partner
+        recv_lo = lax.ppermute(send_hi, axis, perm_up)  # from my lower partner
     except NameError as e:
         raise RuntimeError(
             "update_halo was called on traced (non-concrete) fields outside of an "
@@ -256,16 +289,21 @@ def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
         A = _set_plane(A, recv_hi, n - width, d)
         A = _set_plane(A, recv_lo, 0, d)
     else:
-        # Edge blocks have no source: ppermute delivered zeros there; keep the
-        # old boundary slab (the reference's PROC_NULL neighbors do nothing).
+        # Blocks whose shift falls off the grid have no source: ppermute
+        # delivered zeros there; keep the old boundary slab (the reference's
+        # PROC_NULL neighbors do nothing).
         idx = lax.axis_index(axis)
+        has_upper = (idx + disp >= 0) & (idx + disp < nd)
+        has_lower = (idx - disp >= 0) & (idx - disp < nd)
         A = _set_plane(
             A,
-            jnp.where(idx < nd - 1, recv_hi, _get_plane(A, n - width, d, width)),
+            jnp.where(has_upper, recv_hi, _get_plane(A, n - width, d, width)),
             n - width,
             d,
         )
-        A = _set_plane(A, jnp.where(idx > 0, recv_lo, _get_plane(A, 0, d, width)), 0, d)
+        A = _set_plane(
+            A, jnp.where(has_lower, recv_lo, _get_plane(A, 0, d, width)), 0, d
+        )
     return A
 
 

@@ -76,6 +76,12 @@ def _overlapped_update(update_fn, fields, radius, exchange):
     from jax import lax
 
     gg = _grid.global_grid()
+    if gg.disp != 1:
+        raise ValueError(
+            f"hide_communication supports disp=1 grids only (got disp="
+            f"{gg.disp}); distance-disp exchange is available on the plain "
+            "update_halo path."
+        )
     fields = tuple(fields)
 
     out_aval = jax.eval_shape(

@@ -56,15 +56,24 @@ import math
 #: tiles trade halo-recompute redundancy for fitting smaller volumes).
 _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
 
+#: VMEM the kernel may plan against.  v5e/v5p carry 128 MiB per core; 100 MiB
+#: leaves Mosaic's own margin.  Deliberately a module constant, not a device
+#: query: jax's public API does not expose per-generation VMEM size, and the
+#: kernel's bit-level validation was done on v5e — on a smaller-VMEM
+#: generation, lower this (auto-selection then degrades to smaller
+#: candidates; `fused_support_error` keeps oversized explicit tiles out).
+_VMEM_BUDGET_BYTES = 100 * 1024 * 1024
+
 
 def _tile_error(n0, n1, n2, k, bx, by, itemsize):
     """The validation error a (bx, by) tile would raise, or None if valid."""
     H = 8 * math.ceil(k / 8)
     vmem_need = 5 * (bx + 2 * k) * (by + 2 * H) * n2 * itemsize
-    if vmem_need > 100 * 1024 * 1024:
+    if vmem_need > _VMEM_BUDGET_BYTES:
         return (
             f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of VMEM "
-            "(5 haloed tiles spanning z); shrink the tile or k"
+            f"(5 haloed tiles spanning z; budget {_VMEM_BUDGET_BYTES >> 20} MiB, "
+            "v5e-tuned — see _VMEM_BUDGET_BYTES); shrink the tile or k"
         )
     if n0 % bx != 0 or n1 % by != 0:
         return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"
@@ -85,6 +94,50 @@ def default_tile(shape, k: int, itemsize: int = 4):
     return None
 
 
+def fused_support_error(shape, k: int, itemsize: int = 4,
+                        bx: int | None = None, by: int | None = None) -> str | None:
+    """Why the fused kernel cannot run this config, or None if it can.
+
+    The single source of truth for the kernel's shape/tile envelope — used
+    eagerly by `fused_diffusion_steps` (raise) and by
+    `models.diffusion3d.make_multi_step` (warn once + fall back to the XLA
+    cadence, the reference's runtime-path-selection precedent,
+    `/root/reference/src/update_halo.jl:755-784`).
+    """
+    n0, n1, n2 = shape
+    if k < 2 or k % 2 != 0 or k > 6:
+        return (
+            f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "
+            "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
+            "corrupt tile-corner cells on this toolchain)"
+        )
+    if n2 > 1024:
+        # Bit-level agreement with the XLA path is validated on hardware up
+        # to n2=1024 (an earlier toolchain miscompiled >2-lane-tile tiled
+        # DMAs; the current one is clean, with `pl.multiple_of` alignment
+        # hints on the dynamic offsets).
+        return (
+            f"minor dimension {n2} > 1024 not validated on this toolchain; "
+            "fall back to the XLA path"
+        )
+    if bx is None and by is None:
+        picked = default_tile((n0, n1, n2), k, itemsize)
+        if picked is None:
+            if n1 % 8 != 0:
+                return (
+                    f"y-size {n1} is not a multiple of 8 (DMA sublane "
+                    "alignment); no tile can fit — use the XLA path"
+                )
+            return (
+                f"no tuned tile candidate {_TILE_CANDIDATES} fits volume "
+                f"({n0},{n1},{n2}) with k={k}; pass bx/by explicitly"
+            )
+        return None
+    if bx is None or by is None:
+        return "pass both bx and by, or neither"
+    return _tile_error(n0, n1, n2, k, bx, by, itemsize)
+
+
 def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
                           *, bx: int | None = None, by: int | None = None):
     """Advance ``k`` (even) diffusion steps in one HBM pass.
@@ -95,41 +148,13 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
     the fastest valid `_TILE_CANDIDATES` entry for the volume.
     """
     n0, n1, n2 = T.shape
-    if k < 2 or k % 2 != 0 or k > 6:
-        raise ValueError(
-            f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "
-            "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
-            "corrupt tile-corner cells on this toolchain)."
-        )
-    if n2 > 1024:
-        # Bit-level agreement with the XLA path is validated on hardware up
-        # to n2=1024 (an earlier toolchain miscompiled >2-lane-tile tiled
-        # DMAs; the current one is clean, with `pl.multiple_of` alignment
-        # hints on the dynamic offsets).
-        raise ValueError(
-            f"minor dimension {n2} > 1024 not validated on this toolchain; "
-            "fall back to the XLA path"
-        )
     if T.dtype != Cp.dtype:
         raise ValueError("T and Cp must share a dtype")
-    if bx is None and by is None:
-        picked = default_tile((n0, n1, n2), k, T.dtype.itemsize)
-        if picked is None:
-            if n1 % 8 != 0:
-                raise ValueError(
-                    f"y-size {n1} is not a multiple of 8 (DMA sublane "
-                    "alignment); no tile can fit — use the XLA path"
-                )
-            raise ValueError(
-                f"no tuned tile candidate {_TILE_CANDIDATES} fits volume "
-                f"({n0},{n1},{n2}) with k={k}; pass bx/by explicitly"
-            )
-        bx, by = picked
-    elif bx is None or by is None:
-        raise ValueError("pass both bx and by, or neither")
-    err = _tile_error(n0, n1, n2, k, bx, by, T.dtype.itemsize)
+    err = fused_support_error((n0, n1, n2), k, T.dtype.itemsize, bx, by)
     if err is not None:
         raise ValueError(err)
+    if bx is None:
+        bx, by = default_tile((n0, n1, n2), k, T.dtype.itemsize)
     return _build(n0, n1, n2, str(T.dtype), int(k),
                   float(cx), float(cy), float(cz), int(bx), int(by))(T, Cp)
 

@@ -118,23 +118,7 @@ class _Stencil:
         if self._out_specs is not None:
             out_specs = self._out_specs
         else:
-            # Infer output specs with a probe trace: out_specs=P() preserves
-            # every output's rank (replication promise, never executed), and
-            # eval_shape of the shard_map gives the output tree with the axis
-            # environment in place (so collectives inside `fn` trace fine).
-            from jax.sharding import PartitionSpec as P
-
-            probe = jax.shard_map(
-                self._fn,
-                mesh=gg.mesh,
-                in_specs=tuple(in_specs),
-                out_specs=P(),
-                check_vma=False,
-            )
-            out_shape = jax.eval_shape(probe, *args)
-            out_specs = jax.tree.map(
-                lambda l: _infer_spec_from_ndim(len(l.shape)), out_shape
-            )
+            out_specs = self._infer_out_specs(gg, in_specs, args)
 
         mapped = jax.shard_map(
             self._fn,
@@ -144,6 +128,93 @@ class _Stencil:
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=self._donate)
+
+    def _infer_out_specs(self, gg, in_specs, args):
+        """Output specs, symmetric with the input heuristic: per-block
+        (device-varying) outputs are sharded one block per device; outputs
+        the function made replicated (e.g. a `psum` over the mesh axes) KEEP
+        their local shape instead of being concatenated into dims-many
+        copies.
+
+        Mechanics: a rank-probe (out_specs=P(), never executed) recovers the
+        output tree with the axis environment in place; then ONE
+        `check_vma=True` trace of the shard_map exposes each output's
+        varying-manual-axes set on the inner jaxpr's outvars — an empty set
+        is statically-proven replication.  If that introspection is
+        unavailable (jax version drift), per-output shape-probes test
+        whether `P()` is provable instead (shard_map raises a clear
+        ValueError exactly in the varying case); functions whose bodies do
+        not trace under vma checking at all fall back to rank-based sharding
+        for every output — the pre-round-3 behavior.
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        probe = jax.shard_map(
+            self._fn,
+            mesh=gg.mesh,
+            in_specs=tuple(in_specs),
+            out_specs=P(),
+            check_vma=False,
+        )
+        out_shape = jax.eval_shape(probe, *args)
+        shape_leaves, treedef = jax.tree.flatten(out_shape)
+        rank_specs = [_infer_spec_from_ndim(len(l.shape)) for l in shape_leaves]
+
+        def vma_mapped(specs):
+            return jax.shard_map(
+                self._fn,
+                mesh=gg.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=treedef.unflatten(specs),
+                check_vma=True,
+            )
+
+        try:
+            jaxpr = jax.make_jaxpr(vma_mapped(rank_specs))(*args)
+        except Exception:
+            return treedef.unflatten(rank_specs)  # not vma-traceable: status quo
+        try:
+            (sm_eqn,) = [e for e in jaxpr.eqns if e.primitive.name == "shard_map"]
+            inner = sm_eqn.params["jaxpr"]
+            producer = {id(ov): e for e in inner.eqns for ov in e.outvars}
+
+            def effective_vma(v):
+                # shard_map widens a replicated value to the rank-based
+                # out_spec with a `pvary` cast; the pre-cast vma is the
+                # function's own — unwrap it.
+                for _ in range(8):
+                    e = producer.get(id(v))
+                    if e is None or e.primitive.name != "pvary":
+                        break
+                    v = e.invars[0]
+                return getattr(v.aval, "vma", None)
+
+            vmas = [effective_vma(v) for v in inner.outvars]
+            if len(vmas) == len(shape_leaves) and all(
+                isinstance(v, frozenset) for v in vmas
+            ):
+                return treedef.unflatten(
+                    [P() if not v else r for v, r in zip(vmas, rank_specs)]
+                )
+        except Exception:
+            pass
+        # Introspection shape changed: N per-output probes (slower, same result).
+        specs = list(rank_specs)
+        for i, leaf in enumerate(shape_leaves):
+            if len(leaf.shape) == 0:
+                continue  # scalars are already P()
+            try:
+                jax.eval_shape(
+                    vma_mapped(rank_specs[:i] + [P()] + rank_specs[i + 1:]), *args
+                )
+            except Exception:
+                # Device-varying (shard_map's replication ValueError) — or any
+                # drifted-jax failure mode: keep the per-block sharding, the
+                # safe pre-round-3 behavior.
+                continue
+            specs[i] = P()
+        return treedef.unflatten(specs)
 
 
 def _infer_spec_from_ndim(ndim: int):

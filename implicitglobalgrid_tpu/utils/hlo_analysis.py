@@ -1,0 +1,116 @@
+"""Optimized-HLO dataflow analysis for the overlap-evidence checks.
+
+Shared by `tests/test_stencil_overlap.py` (8-device CPU mesh, differential
+control) and `scripts/verify_tpu.py` (AOT TPU topology program): one parser,
+one transitive-closure walk, one fusion-size heuristic — so a fix to the
+analyzer cannot drift between the test and the hardware check.
+
+The schedulability criterion: a collective-permute whose transitive operand
+closure contains the full-block interior fusion can only start AFTER the
+interior finishes (a barrier); one whose closure holds only slab-sized ops is
+free to fly while the interior computes — the structural freedom
+`hide_communication` exists to create (the reference's analogous mechanism is
+its max-priority streams, `/root/reference/src/update_halo.jl:424`).
+"""
+
+from __future__ import annotations
+
+import re
+
+# Instruction name + everything after '='.  The type is NOT captured as one
+# token: TPU HLO tuple types contain spaces and nested parens
+# (`(f32[1,16,16]{1,0,2:T(1,128)S(1)}, u32[]{:S(2)})`), so the op kind and
+# operand refs are extracted from the remainder instead.
+_INST_RE = re.compile(r"\s*(?:ROOT\s+)?%?([\w\.\-]+)\s*=\s*(.*)$")
+
+
+def parse_computations(txt: str) -> dict[str, list[str]]:
+    """Split optimized HLO text into {computation_name: [instruction lines]}."""
+    comps: dict[str, list[str]] = {}
+    cur = None
+    for line in txt.splitlines():
+        if line.rstrip().endswith("{") and "(" in line:
+            cur = line.split("(")[0].strip()
+            cur = cur[len("ENTRY "):] if cur.startswith("ENTRY ") else cur
+            cur = cur.lstrip("%")
+            comps[cur] = []
+        elif line.strip() == "}":
+            cur = None
+        elif cur is not None:
+            comps[cur].append(line)
+    return comps
+
+
+def _out_elems(typ: str) -> int:
+    """Largest array size in an HLO type string (handles tuple types)."""
+    best = 0
+    for shp in re.findall(r"\[([\d,]*)\]", typ):
+        if shp:
+            p = 1
+            for x in shp.split(","):
+                p *= int(x)
+            best = max(best, p)
+    return best
+
+
+def _op_kind(rest: str) -> str:
+    """Classify the instruction from the text after '='."""
+    if "collective-permute-start(" in rest:
+        return "collective-permute-start"
+    if "collective-permute-done(" in rest:
+        return "collective-permute-done"
+    if re.search(r"\bcollective-permute\(", rest):
+        return "collective-permute"
+    if re.search(r"\bfusion\(", rest):
+        return "fusion"
+    return "other"
+
+
+def collective_waits(txt: str, big_elems: int) -> tuple[int, list[bool], int]:
+    """Analyze every HLO computation holding collective-permutes.
+
+    Returns ``(n_collectives, waits, n_async)`` where ``waits[i]`` says
+    whether collective ``i`` (sync ``collective-permute`` or async
+    ``collective-permute-start``) transitively depends on a fusion with
+    >= ``big_elems`` output elements, and ``n_async`` counts the async
+    start ops (TPU backend; the CPU backend emits sync collectives only).
+    Closures are computed within each computation — the collectives and the
+    interior fusion always share one (the SPMD entry or a loop body).
+    """
+    n_total, waits_all, n_async = 0, [], 0
+    for lines in parse_computations(txt).values():
+        if not any("collective-permute" in l for l in lines):
+            continue
+        insts: dict[str, tuple[str, str, list[str]]] = {}
+        for l in lines:
+            m = _INST_RE.match(l)
+            if m:
+                name, rest = m.groups()
+                insts[name] = (_op_kind(rest), rest, re.findall(r"%([\w\.\-]+)", rest))
+
+        big = {
+            n
+            for n, (op, rest, _) in insts.items()
+            if op == "fusion" and _out_elems(rest) >= big_elems
+        }
+
+        def closure(n, seen):
+            stack = [n]
+            while stack:  # iterative: deep programs exceed the recursion limit
+                for o in insts.get(stack.pop(), (None, None, []))[2]:
+                    if o not in seen:
+                        seen.add(o)
+                        stack.append(o)
+            return seen
+
+        cps = [
+            n
+            for n, (op, _, _) in insts.items()
+            if op in ("collective-permute", "collective-permute-start")
+        ]
+        n_total += len(cps)
+        waits_all += [bool(closure(c, set()) & big) for c in cps]
+        n_async += sum(
+            1 for op, _, _ in insts.values() if op == "collective-permute-start"
+        )
+    return n_total, waits_all, n_async

@@ -14,7 +14,12 @@ Checks:
     per-step XLA path on a communicating (periodic) grid,
  4. the XLA-only slab cadence (`exchange_every`) matching per-step to
     few f32 ULPs (per-program FMA contraction),
- 5. example `diffusion3d_tpu_fused` end-to-end.
+ 5. example `diffusion3d_tpu_fused` end-to-end,
+ 6. the hide_communication overlap schedule in the TPU backend's compiled
+    multi-chip program: async collective-permute-start/-done pairs present,
+    and no exchange waiting on the interior fusion (AOT topology compile;
+    skipped with a pointer to the CPU-mesh dataflow test when the runtime
+    cannot compile for a multi-chip topology).
 """
 
 import os
@@ -136,6 +141,100 @@ def check_example():
     print("5. fused example end-to-end: OK")
 
 
+def _aot_hide_comm_hlo():
+    """Compile the hide_comm step for an 8-chip TPU topology AOT (no second
+    chip needed); returns the optimized HLO text, or raises when the runtime
+    cannot compile for a multi-chip topology (the only legitimate skip)."""
+    import numpy as np
+
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    kind = jax.devices()[0].device_kind
+    topo = None
+    for name in (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"):
+        try:
+            topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
+            break
+        except Exception:
+            continue
+    if topo is None:
+        raise RuntimeError("no AOT topology description available")
+    devs = np.asarray(topo.devices)[:8].reshape(2, 2, 2)
+    mesh = Mesh(devs, ("x", "y", "z"))
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.ops.overlap import hide_communication
+    from implicitglobalgrid_tpu.parallel import grid as _grid
+
+    # Build the per-block program against the AOT mesh via a synthetic
+    # GlobalGrid (the public init path binds to the attached client's
+    # devices, which is exactly what AOT avoids).
+    import dataclasses
+
+    igg.init_global_grid(16, 16, 16, quiet=True, devices=list(jax.devices())[:1])
+    gg0 = igg.get_global_grid()
+    gg = dataclasses.replace(
+        gg0, mesh=mesh, dims=(2, 2, 2), nprocs=8, coords=(0, 0, 0)
+    )
+    _grid.set_global_grid(gg)
+    try:
+        params = diffusion3d.Params(
+            dx=0.1, dy=0.1, dz=0.1, dt=1e-4, dtype=np.float32, hide_comm=True
+        )
+        update = diffusion3d._diffusion_update(params)
+        overlapped = hide_communication(update, radius=1)
+
+        def block_step(T, Cp):
+            return overlapped(T, Cp), Cp
+
+        mapped = jax.jit(
+            jax.shard_map(
+                block_step, mesh=mesh,
+                in_specs=(P("x", "y", "z"),) * 2,
+                out_specs=(P("x", "y", "z"),) * 2,
+                check_vma=False,
+            )
+        )
+        aval = jax.ShapeDtypeStruct(
+            (32, 32, 32), np.float32, sharding=NamedSharding(mesh, P("x", "y", "z"))
+        )
+        return mapped.lower(aval, aval).compile().as_text()
+    finally:
+        _grid.set_global_grid(gg0)
+        igg.finalize_global_grid()
+
+
+def check_overlap_schedule():
+    """Pin the overlap claim on the real backend's compiled program: async
+    collective-permute-start/-done pairs + no exchange waiting on the
+    interior fusion.  Only the AOT compile itself may skip; a failed
+    ASSERTION on the obtained program fails the whole script."""
+    from implicitglobalgrid_tpu.utils.hlo_analysis import collective_waits
+
+    try:
+        txt = _aot_hide_comm_hlo()
+    except Exception as e:  # noqa: BLE001 — report and point at the CPU pin
+        print(
+            f"6. overlap schedule: SKIPPED ({type(e).__name__}: {e}) — the "
+            "dataflow property is pinned by tests/test_stencil_overlap.py::"
+            "test_hide_comm_collectives_do_not_wait_on_interior on the "
+            "8-device CPU mesh"
+        )
+        return
+    n_cp, waits, n_async = collective_waits(txt, 16 * 16 * 16)
+    assert n_cp >= 6, f"expected >= 6 exchanges in the AOT program, got {n_cp}"
+    assert n_async > 0, "TPU program has no async collective-permute-start"
+    assert "collective-permute-done" in txt
+    assert not any(waits), f"exchange waits on the interior fusion: {waits}"
+    print(
+        f"6. overlap schedule (AOT 2x2x2): OK — {n_async} async "
+        "collective-permute-start/-done pairs, none waiting on the interior"
+    )
+
+
 if __name__ == "__main__":
     import jax
 
@@ -145,4 +244,5 @@ if __name__ == "__main__":
     check_deep_halo_slab()
     check_cadence()
     check_example()
+    check_overlap_schedule()
     print("ALL TPU CHECKS PASSED")

@@ -52,6 +52,22 @@ def test_tpu_onlyvis_importable():
     assert not igg.grid_is_initialized()
 
 
+def test_tpu_onlyvis_recipe_runs():
+    # The onlyvis visualization recipe (strip halo -> gather -> mid-plane
+    # frame) must execute end to end on a tiny grid, like the reference's
+    # examples/diffusion3D_multigpu_CuArrays_onlyvis.jl recipe.
+    import implicitglobalgrid_tpu as igg
+
+    mod = _load("diffusion3d_tpu_onlyvis")
+    frames = mod.diffusion3d(nx=8, nt=4, nvis=2, quiet=True)
+    assert len(frames) == 2  # it = 0 and 2
+    gg_dims = 2  # 8 devices -> 2x2x2
+    for f in frames:
+        assert f.shape == ((8 - 2) * gg_dims, (8 - 2) * gg_dims)
+        assert np.isfinite(f).all()
+    assert not igg.grid_is_initialized()
+
+
 def test_tpu_fused_runs():
     # The deep-halo temporal-blocking example on the virtual mesh (interpret-
     # mode kernel; overlap=2k licenses fused_k=k on the communicating grid).

@@ -157,6 +157,25 @@ def test_fused_deep_halo_matches_xla_multiblock():
     np.testing.assert_allclose(T_fused, T_xla, rtol=1e-5, atol=1e-5)
 
 
+def test_fused_fallback_warns_and_matches_xla():
+    """A local block the kernel envelope rejects (y-size not a multiple of 8)
+    must warn once and run the XLA path at the same exchange cadence —
+    bit-identical to the per-step path at group boundaries."""
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    state, params = diffusion3d.setup(10, 10, 10, **kw)
+    step = diffusion3d.make_multi_step(params, 4, donate=False)
+    T_ref = np.asarray(igg.gather(jax.block_until_ready(step(*state))[0]))
+    igg.finalize_global_grid()
+
+    state, params = diffusion3d.setup(10, 10, 10, **kw)
+    with pytest.warns(RuntimeWarning, match="falling back to the XLA path"):
+        stepf = diffusion3d.make_multi_step(params, 4, donate=False, fused_k=2)
+        state = jax.block_until_ready(stepf(*state))
+    T_fb = np.asarray(igg.gather(state[0]))
+    igg.finalize_global_grid()
+    np.testing.assert_array_equal(T_fb, T_ref)
+
+
 def test_fused_requires_deep_halo():
     state, params = diffusion3d.setup(
         16, 32, 128, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, quiet=True

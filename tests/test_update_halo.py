@@ -50,11 +50,25 @@ def unblocks(blocks, dims, lshape, nd, dtype):
 
 def simulate_update_halo(global_np, gg, width=1):
     """Numpy re-implementation of the reference exchange for one field
-    (``width`` planes per side; width=1 is the reference's exchange)."""
+    (``width`` planes per side; width=1 is the reference's exchange).
+    Partners sit at Cartesian distance ``gg.disp`` — ``MPI_Cart_shift(d,
+    disp)`` semantics, independently re-derived from
+    `/root/reference/src/init_global_grid.jl:89-92`."""
     nd = global_np.ndim
     w = width
+    dsp = int(gg.disp)
     lshape = tuple(s // gg.dims[d] for d, s in enumerate(global_np.shape))
     blocks = blocks_of(global_np, gg.dims, lshape)
+
+    def partner(c, d, D, per, offset):
+        ci = list(c)
+        ci[d] = c[d] + offset
+        if per:
+            ci[d] %= D
+        elif not (0 <= ci[d] < D):
+            return None
+        return tuple(ci)
+
     for d in range(3):
         if d >= nd:
             continue
@@ -76,24 +90,18 @@ def simulate_update_halo(global_np, gg, width=1):
             sends[c] = (b[tuple(sl_lo)].copy(), b[tuple(sl_hi)].copy())
         # unpack
         for c, b in blocks.items():
-            ci = list(c)
-            # receive into hi slab [n-w, n) from upper neighbor's lo send
-            ci[d] = c[d] + 1
-            if ci[d] >= D:
-                ci[d] = 0 if per else None
-            if ci[d] is not None:
+            # receive into hi slab [n-w, n) from the upper partner's lo send
+            ci = partner(c, d, D, per, dsp)
+            if ci is not None:
                 sl = [slice(None)] * nd
                 sl[d] = slice(n - w, n)
-                b[tuple(sl)] = sends[tuple(ci)][0]
-            # receive into lo slab [0, w) from lower neighbor's hi send
-            ci = list(c)
-            ci[d] = c[d] - 1
-            if ci[d] < 0:
-                ci[d] = D - 1 if per else None
-            if ci[d] is not None:
+                b[tuple(sl)] = sends[ci][0]
+            # receive into lo slab [0, w) from the lower partner's hi send
+            ci = partner(c, d, D, per, -dsp)
+            if ci is not None:
                 sl = [slice(None)] * nd
                 sl[d] = slice(0, w)
-                b[tuple(sl)] = sends[tuple(ci)][1]
+                b[tuple(sl)] = sends[ci][1]
     return unblocks(blocks, gg.dims, lshape, nd, global_np.dtype)
 
 
@@ -210,6 +218,55 @@ def test_overlap3_periodic():
     check((8, 8, 8), [(8, 8, 8)], overlapx=3, periodx=1)
 
 
+def test_disp2_nonperiodic():
+    """Distance-2 partners (`MPI_Cart_shift(d, 2)` semantics): the exchange
+    must talk to exactly the blocks in `GlobalGrid.neighbors` — the round-2
+    parity bug had the neighbors table honoring ``disp`` while the exchange
+    hard-coded shift +-1.  dims=(4,2,1): x has distance-2 partners, y's
+    shifts all fall off the grid (every partner PROC_NULL), z has no
+    neighbors at all."""
+    check((6, 6, 6), [(6, 6, 6)], disp=2, dimx=4, dimy=2, dimz=1)
+
+
+def test_disp2_periodic_wrap():
+    # Periodic distance-2 partners: (c +- 2) mod 4 in x; in y the wrap
+    # (c +- 2) mod 2 == c makes every block its own partner (the reference's
+    # self-neighbor path, reached via Cart_shift wrap instead of dims==1).
+    check((6, 6, 6), [(6, 6, 6)], disp=2, dimx=4, dimy=2, dimz=1,
+          periodx=1, periody=1)
+
+
+def test_disp_negative():
+    # Cart_shift with a negative displacement swaps the partner directions;
+    # the neighbors table and the exchange must agree there too.
+    check((6, 6, 6), [(6, 6, 6)], disp=-1, dimx=4, dimy=2, dimz=1)
+
+
+def test_disp2_staggered_and_width():
+    # disp composes with shape-aware staggered ol and deep-halo slabs.
+    check((8, 8, 8), [(8, 8, 8), (9, 8, 8)], disp=2, dimx=4, dimy=2, dimz=1,
+          width=2, overlapx=4, overlapy=4, overlapz=4)
+
+
+def test_disp2_all_proc_null_dim_needs_no_deep_halo():
+    # dims=(4,2,1) with disp=2: every y-shift falls off the grid (all
+    # partners PROC_NULL), so a width-2 slab exchange must skip y silently —
+    # the deep-halo requirement applies only to dimensions that exchange.
+    check((8, 8, 8), [(8, 8, 8)], disp=2, dimx=4, dimy=2, dimz=1,
+          width=2, overlapx=4)  # overlapy stays at the shallow default
+
+
+def test_disp_not_1_rejected_by_hide_communication():
+    igg.init_global_grid(6, 6, 6, disp=2, quiet=True)
+    from implicitglobalgrid_tpu.ops.overlap import hide_communication
+
+    wrapped = igg.stencil(hide_communication(lambda T: T + 0.0, radius=1))
+    A = put(unique_field((6, 6, 6), igg.get_global_grid()))
+    with pytest.raises(ValueError, match="disp=1 grids only"):
+        wrapped(A)
+    igg.finalize_global_grid()
+
+
 def test_slab_width2():
     # Deep-halo slab exchange (width=2 on overlap-4 grids): the temporal-
     # blocking transport (one collective per k fused steps).
@@ -284,20 +341,30 @@ def test_multi_field_mixed_dtypes():
 
 
 @pytest.mark.parametrize(
-    "dtype", ["float16", "bfloat16", "float32", "float64", "int16", "int32", "complex64"]
+    "dtype",
+    ["float16", "bfloat16", "float32", "float64", "int16", "int32",
+     "complex64", "complex128"],
 )
 def test_dtypes(dtype):
-    # reference dtype matrix: test_update_halo.jl:109-177,938-952
-    if dtype == "complex64":
+    # reference dtype matrix: test_update_halo.jl:109-177,938-952 (ComplexF64
+    # included there; x64 is enabled in this suite so complex128 is exact)
+    if dtype in ("complex64", "complex128"):
         igg.init_global_grid(6, 6, 6, periodx=1, quiet=True)
         gg = igg.get_global_grid()
-        re = unique_field((6, 6, 6), gg, np.float32)
-        f = (re + 1j * (re + 0.5)).astype(np.complex64)
+        re = unique_field((6, 6, 6), gg, np.float64 if dtype == "complex128" else np.float32)
+        f = (re + 1j * (re + 0.5)).astype(dtype)
         out = np.asarray(igg.update_halo(put(f)))
         np.testing.assert_array_equal(out, simulate_update_halo(f, gg))
         igg.finalize_global_grid()
     else:
         check((6, 6, 6), [(6, 6, 6)], dtype=np.dtype(dtype) if dtype != "bfloat16" else jnp.bfloat16, periodx=1)
+
+
+def test_float64_deep_halo_slab():
+    # f64 width-2 slab exchange (the deep-halo path crossed with the x64
+    # dtype matrix, matching the reference's Float64-heavy suite).
+    check((8, 8, 8), [(8, 8, 8)], dtype=np.float64, width=2,
+          overlapx=4, overlapy=4, overlapz=4, periodx=1)
 
 
 def test_idempotent_when_consistent():
