@@ -70,13 +70,17 @@ def main():
         except Exception as e:
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
 
-    def _fused_record(r, n, k, tile=(None, None)):
+    def _fused_record(r, n, k, tile=(None, None), support_error=None):
         # Deterministic provenance: the same envelope check the fallback
         # uses (single-chip bench => local block == n^3 float32), not a
         # warn-once side channel that a second same-config build would miss.
-        from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
+        # ``support_error`` selects the kernel's envelope (default: the
+        # diffusion kernel's).
+        if support_error is None:
+            from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
 
-        err = fused_support_error((n, n, n), k, 4, *tile)
+            support_error = fused_support_error
+        err = support_error((n, n, n), k, 4, *tile)
         return {
             "teff": r["value"],
             "t_it_ms": r["t_it_ms"],
@@ -123,6 +127,18 @@ def main():
         )
         return {"teff": r["value"], "t_it_ms": r["t_it_ms"]}
 
+    def _acoustic_fused():
+        # The staggered fused kernel (ops/pallas_leapfrog.py, k=6 tuned on
+        # v5e) needs a 128-multiple minor dim, so it benches at 256^3 (the
+        # 192^3 XLA number above is the faster XLA config; 256^3 sits past
+        # the minor-dim cliff, see docs/performance.md).
+        from implicitglobalgrid_tpu.ops.pallas_leapfrog import fused_support_error
+
+        r = _bench.bench_acoustic(
+            n=256, chunk=24, reps=3, dtype="float32", emit=False, fused_k=6
+        )
+        return _fused_record(r, 256, 6, support_error=fused_support_error)
+
     def _porous():
         # 160^3: the smallest size whose state spills VMEM on v5e, giving a
         # stable HBM-bound number (at 128^3 the ~67 MB state is largely
@@ -136,6 +152,7 @@ def main():
     _extra("diffusion_xla_overlap", _overlap)
     _extra("acoustic", _acoustic)
     _extra("acoustic_overlap", _acoustic_overlap)
+    _extra("acoustic_256_pallas_fused6", _acoustic_fused)
     _extra("porous_pt", _porous)
     best = rec["value"]
     extras["headline_path"] = "xla"

@@ -188,7 +188,11 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
 
 
 def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None,
-                   emit=True, exchange_every=1, overlap=None):
+                   emit=True, exchange_every=1, overlap=None, fused_k=None,
+                   fused_tile=None):
+    """``fused_k``: the temporally-blocked staggered Pallas kernel
+    (`ops/pallas_leapfrog.py`, k leapfrog steps per HBM pass) — needs
+    ``n % 128 == 0`` in the minor dimension (use ``--n 256``)."""
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -204,7 +208,8 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         devices=devices, **okw,
     )
     step = acoustic3d.make_multi_step(
-        params, chunk, donate=False, exchange_every=exchange_every
+        params, chunk, donate=False, exchange_every=exchange_every,
+        fused_k=fused_k, fused_tile=fused_tile,
     )
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
@@ -213,6 +218,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
     return _emit(
         f"acoustic3d_{n}_{dtype}"
         + ("_overlap" if hide_comm else "")
+        + (f"_fused{fused_k}" if fused_k else "")
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
@@ -326,8 +332,9 @@ def main():
         bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k,
                         exchange_every=a.exchange_every, overlap=a.overlap, **kw)
     if a.what in ("acoustic", "all"):
-        bench_acoustic(n=a.n or 192, hide_comm=a.hide_comm,
-                       exchange_every=a.exchange_every, overlap=a.overlap, **kw)
+        bench_acoustic(n=a.n or (256 if a.fused_k else 192), hide_comm=a.hide_comm,
+                       fused_k=a.fused_k, exchange_every=a.exchange_every,
+                       overlap=a.overlap, **kw)
     if a.what in ("porous", "all"):
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly

@@ -1,0 +1,32 @@
+"""Shared fused-kernel fallback warning (diffusion + acoustic + porous).
+
+The reference's precedent is runtime path selection by threshold
+(`/root/reference/src/update_halo.jl:755-784`); here the selection happens at
+trace time against the kernel envelope (`fused_support_error`), warning once
+per (shape, k, reason) so production loops are not spammed.
+"""
+
+from __future__ import annotations
+
+_warned: set = set()
+
+
+def warn_fused_fallback(shape, k, err, model: str = "diffusion") -> None:
+    """Warn once per (model, shape, k, reason) that fused_k fell back to XLA.
+
+    ``model`` keys the registry per kernel: the diffusion and leapfrog
+    envelopes share reason strings, and one model's fallback must not
+    silence another's first warning.
+    """
+    import warnings
+
+    key = (model, shape, k, err)
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(
+        f"fused_k={k} is unsupported for {model}'s local block shape {shape} "
+        f"({err}); falling back to the XLA path at the same exchange cadence.",
+        RuntimeWarning,
+        stacklevel=3,
+    )

@@ -183,7 +183,8 @@ def make_step(params: Params, *, donate: bool = True):
 
 
 def make_multi_step(
-    params: Params, nsteps: int, *, donate: bool = True, exchange_every: int = 1
+    params: Params, nsteps: int, *, donate: bool = True, exchange_every: int = 1,
+    fused_k: int | None = None, fused_tile: tuple[int, int] | None = None,
 ):
     """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`).
 
@@ -195,11 +196,133 @@ def make_multi_step(
     neighbor's still-exact planes).  One collective per ``w`` steps; states
     at group boundaries identical up to compiler fusion rounding (bitwise on
     the CPU mesh; few f32 ULPs on TPU).
+
+    ``fused_k``: advance ``fused_k`` leapfrog steps per HBM pass with the
+    temporally-blocked staggered Pallas kernel (`ops/pallas_leapfrog.py`) —
+    the staggered sibling of the diffusion model's ``fused_k``, made possible
+    by the even-extent padded face layout (`pad_faces`).  On a grid with no
+    halo activity the kernel runs alone (pad once per chunk).  On a
+    communicating grid every dimension with halo activity needs
+    ``overlap >= 2*fused_k``; the chunk then alternates ``fused_k`` kernel
+    steps with ONE width-``fused_k`` slab exchange of all four fields (the
+    same all-field slab as ``exchange_every`` — the kernel's k-deep
+    contaminated rind is exactly the slab the exchange refreshes).  Local
+    blocks the kernel envelope rejects warn once and run the XLA path at the
+    same cadence (`fused_support_error` is the single source of truth).
+    Requires ``nsteps % fused_k == 0``.
     """
     from jax import lax
 
     v_update = _velocity_update(params)
     p_update = _pressure_update(params)
+
+    if fused_k:
+        import jax
+
+        from ..ops.halo import dim_has_halo_activity, require_deep_halo
+        from ..ops.pallas_leapfrog import (
+            fused_leapfrog_steps,
+            fused_support_error,
+            pad_faces,
+            unpad_faces,
+        )
+        from ..parallel.grid import global_grid
+        from ._fused import warn_fused_fallback
+
+        gg = global_grid()
+        if params.hide_comm:
+            raise ValueError(
+                "fused_k and hide_comm are mutually exclusive: the fused "
+                "kernel's slab exchange is already amortized over k steps; "
+                "overlap scheduling applies to the per-step XLA path."
+            )
+        if nsteps % fused_k != 0:
+            raise ValueError(f"nsteps={nsteps} must be a multiple of fused_k={fused_k}")
+        if exchange_every not in (1, fused_k):
+            raise ValueError(
+                f"fused_k={fused_k} already exchanges every fused_k steps; "
+                f"exchange_every={exchange_every} conflicts."
+            )
+        require_deep_halo(fused_k, gg, what="fused_k")
+        active = [d for d in range(3) if dim_has_halo_activity(gg, d)]
+        cax = params.dt / params.rho / params.dx
+        cay = params.dt / params.rho / params.dy
+        caz = params.dt / params.rho / params.dz
+        b = params.dt * params.K
+        idx, idy, idz = 1.0 / params.dx, 1.0 / params.dy, 1.0 / params.dz
+        bx, by = fused_tile if fused_tile is not None else (None, None)
+        if (bx is None) != (by is None):
+            raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
+
+        def kernel_steps(P, Vxp, Vyp, Vzp):
+            return fused_leapfrog_steps(
+                P, Vxp, Vyp, Vzp, fused_k, cax, cay, caz, b, idx, idy, idz,
+                bx=bx, by=by,
+            )
+
+        def xla_step(s):
+            P, Vx, Vy, Vz = s
+            Vx, Vy, Vz = v_update(P, Vx, Vy, Vz)
+            return p_update(P, Vx, Vy, Vz), Vx, Vy, Vz
+
+        def fused_or_fallback(P, Vx, Vy, Vz, fused_body, xla_body):
+            err = fused_support_error(tuple(P.shape), fused_k, P.dtype.itemsize, bx, by)
+            if err is None:
+                return fused_body(P, Vx, Vy, Vz)
+            warn_fused_fallback(tuple(P.shape), fused_k, err, model="acoustic")
+            return xla_body(P, Vx, Vy, Vz)
+
+        if not active:
+
+            def fused_chunk(P, Vx, Vy, Vz):
+                # Pad once per chunk; the kernel keeps the padded layout
+                # across all groups (no exchange to serve).
+                padded = pad_faces(Vx, Vy, Vz)
+
+                def body(i, s):
+                    return kernel_steps(*s)
+
+                P, Vxp, Vyp, Vzp = lax.fori_loop(
+                    0, nsteps // fused_k, body, (P, *padded)
+                )
+                return (P, *unpad_faces(Vxp, Vyp, Vzp))
+
+            def xla_chunk(P, Vx, Vy, Vz):
+                return lax.fori_loop(
+                    0, nsteps, lambda i, s: xla_step(s), (P, Vx, Vy, Vz)
+                )
+
+            # No halo activity = no collectives: plain jit on the grid's
+            # single device (same rationale as the diffusion fused path).
+            return jax.jit(
+                lambda *s: fused_or_fallback(*s, fused_chunk, xla_chunk),
+                donate_argnums=tuple(range(4)) if donate else (),
+            )
+
+        def fused_block_step(P, Vx, Vy, Vz):
+            def group(i, s):
+                P, Vx, Vy, Vz = s
+                Vxp, Vyp, Vzp = pad_faces(Vx, Vy, Vz)
+                P, Vxp, Vyp, Vzp = kernel_steps(P, Vxp, Vyp, Vzp)
+                Vx, Vy, Vz = unpad_faces(Vxp, Vyp, Vzp)
+                # One all-field slab exchange licenses the next fused_k
+                # steps (see the exchange_every docstring for why P's slab
+                # must ride along).
+                return update_halo(P, Vx, Vy, Vz, width=fused_k)
+
+            return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
+
+        def xla_cadence_step(P, Vx, Vy, Vz):
+            def group(i, s):
+                s = lax.fori_loop(0, fused_k, lambda j, s: xla_step(s), s)
+                return update_halo(*s, width=fused_k)
+
+            return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
+
+        return stencil(
+            lambda *s: fused_or_fallback(*s, fused_block_step, xla_cadence_step),
+            donate_argnums=tuple(range(4)) if donate else (),
+        )
 
     if exchange_every < 1:
         raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")

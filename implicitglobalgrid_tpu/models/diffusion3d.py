@@ -71,23 +71,7 @@ def _inn(A):
     return A[1:-1, 1:-1, 1:-1]
 
 
-_fused_fallback_warned: set = set()
-
-
-def _warn_fused_fallback(shape, k, err) -> None:
-    """Warn once per (shape, k, reason) that fused_k fell back to XLA."""
-    import warnings
-
-    key = (shape, k, err)
-    if key in _fused_fallback_warned:
-        return
-    _fused_fallback_warned.add(key)
-    warnings.warn(
-        f"fused_k={k} is unsupported for local block shape {shape} ({err}); "
-        "falling back to the XLA path at the same exchange cadence.",
-        RuntimeWarning,
-        stacklevel=3,
-    )
+from ._fused import warn_fused_fallback as _warn_fused_fallback  # shared w/ acoustic
 
 
 def _gaussians(X, Y, Z, params: Params, jnp):

@@ -384,6 +384,18 @@ def make_multi_step(
     else:
         block_step = _build_block_step(params)
 
+    # The Python unroll is only cheap for production-sized chunks; past this
+    # the trace/HLO grows linearly (each step carries npt PT iterations) and
+    # compile time explodes long before any dispatch saving pays back.
+    # Callers wanting more steps per sync should call the chunk repeatedly.
+    if nsteps > 64:
+        raise ValueError(
+            f"nsteps={nsteps} would unroll {nsteps} whole time steps into one "
+            "program (the outer loop is unrolled by measurement — a nested "
+            "fori_loop costs ~35% on v5e); keep chunks <= 64 and call the "
+            "step function repeatedly instead"
+        )
+
     def multi(*s):
         for _ in range(nsteps):  # unrolled: see the loop-structure note above
             s = block_step(*s)

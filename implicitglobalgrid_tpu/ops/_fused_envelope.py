@@ -1,0 +1,86 @@
+"""Shared envelope control flow for the temporally-blocked Pallas kernels.
+
+`ops/pallas_stencil.py` (cell-centered diffusion) and `ops/pallas_leapfrog.py`
+(staggered leapfrog) share every hardware-probed constraint except the VMEM
+accounting of their working sets: k even in [2, 6], minor dim <= 1024
+(validated ceiling) and a multiple of 128 (Mosaic requires lane-tile-aligned
+minor extents on HBM memref slices — probed at n2=192, round 3), y-size a
+multiple of 8 (sublane-aligned second-minor DMA windows), tuned-candidate
+auto-selection.  Keeping the control flow here means a newly probed
+constraint lands in ONE place — the round-3 lane-alignment find had to be
+retrofitted into the diffusion envelope precisely because each kernel
+carried its own copy.
+
+Each kernel supplies its own ``tile_error(n0, n1, n2, k, bx, by, itemsize)``
+(VMEM budget + divisibility for its specific buffer set) and its candidate
+list; this module owns everything kernel-independent.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def aligned_halo(k: int) -> int:
+    """y-halo padded to sublane alignment: ``H = 8*ceil(k/8)``."""
+    return 8 * math.ceil(k / 8)
+
+
+def default_tile(shape, k, itemsize, *, tile_error, candidates):
+    """First candidate ``tile_error`` accepts for ``shape``, or None."""
+    n0, n1, n2 = shape
+    for bx, by in candidates:
+        if tile_error(n0, n1, n2, k, bx, by, itemsize) is None:
+            return (bx, by)
+    return None
+
+
+def support_error(shape, k, itemsize, bx, by, *, tile_error, candidates):
+    """The kernel-independent envelope checks + tile-selection flow.
+
+    Returns the reason the config cannot run, or None if it can — the
+    single source of truth behind each kernel's ``fused_support_error``.
+    """
+    n0, n1, n2 = shape
+    if k < 2 or k % 2 != 0 or k > 6:
+        return (
+            f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "
+            "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
+            "corrupt tile-corner cells on this toolchain)"
+        )
+    if n2 > 1024:
+        # Bit-level agreement with the XLA path is validated on hardware up
+        # to n2=1024 (an earlier toolchain miscompiled >2-lane-tile tiled
+        # DMAs; the current one is clean, with `pl.multiple_of` alignment
+        # hints on the dynamic offsets).
+        return (
+            f"minor dimension {n2} > 1024 not validated on this toolchain; "
+            "fall back to the XLA path"
+        )
+    if n2 % 128 != 0:
+        # Mosaic requires HBM memref slices to have lane-tile-aligned minor
+        # extents ("Slice shape along dimension 2 must be aligned to tiling
+        # (128)") — probed on hardware at n2=192 (round 3); every validated
+        # size (256/512/1024) is a multiple of 128.
+        return (
+            f"minor dimension {n2} is not a multiple of 128 (lane-tile "
+            "alignment for HBM slices); fall back to the XLA path"
+        )
+    if bx is None and by is None:
+        picked = default_tile(
+            (n0, n1, n2), k, itemsize, tile_error=tile_error, candidates=candidates
+        )
+        if picked is None:
+            if n1 % 8 != 0:
+                return (
+                    f"y-size {n1} is not a multiple of 8 (DMA sublane "
+                    "alignment); no tile can fit — use the XLA path"
+                )
+            return (
+                f"no tuned tile candidate {candidates} fits volume "
+                f"({n0},{n1},{n2}) with k={k}; pass bx/by explicitly"
+            )
+        return None
+    if bx is None or by is None:
+        return "pass both bx and by, or neither"
+    return tile_error(n0, n1, n2, k, bx, by, itemsize)

@@ -1,0 +1,443 @@
+"""Pallas TPU kernel: temporally-blocked fused staggered leapfrog steps.
+
+The staggered sibling of `ops/pallas_stencil.py` (same custom-kernel lever as
+the reference's pack kernels, `/root/reference/src/update_halo.jl:599-649`):
+advance ``k`` velocity–pressure leapfrog steps of the acoustic model
+(`models/acoustic3d.py`) in ONE HBM round trip per field.  The XLA acoustic
+path is at its streaming roofline (12 real passes/step, see
+`docs/performance.md`); temporal blocking cuts that to ~``(8.6·R + 4)/k``
+passes/step (R = halo-recompute redundancy), the only remaining lever.
+
+**Why this works where the naive staggered tile faulted.**  A face field of
+shape ``n+1`` sliced directly gives DMA extents of odd size in the
+second-minor or minor dimension — probed on hardware to crash the TPU worker
+(odd-extent second-minor DMA).  The fix is an *even-extent padded layout*:
+each velocity field is carried in an array padded to ``n+8`` along its own
+staggered axis (``pad_faces``), holding all ``n+1`` real faces plus 7 junk
+planes.  Every window fetch then has 8-aligned offsets and
+multiple-of-8-extents in the second-minor dimension (x-axis padding is
+unconstrained — it is the major dimension), and every minor-dimension copy
+moves the full minor extent.  No odd-extent slice exists anywhere in the
+kernel:
+
+* ``P``  (cells)   window ``(SX,   SY,   n2)``    at ``(sx, sy)``
+* ``Vx`` (x-faces) window ``(SX+8, SY,   n2)``    at ``(sx, sy)`` — local
+  face ``j`` is global face ``sx+j``; the +8 rows cover the window's top
+  face ``SX`` (real data or the global frozen face) plus junk.
+* ``Vy`` (y-faces) window ``(SX,   SY+8, n2)``
+* ``Vz`` (z-faces) window ``(SX,   SY,   n2+128)`` — full minor extent
+  (z is the minor dimension, where Mosaic requires lane-tile-aligned
+  extents, so the z pad is 128, not 8).
+
+Output DMAs write only each tile's *owned* ``(bx, by)`` block of cells and
+faces ``[i·b, i·b + b)`` — an exact partition of faces ``0..n-1``; the top
+global face ``n`` is frozen (rigid wall / exchange-refreshed rind) and never
+updated, so it needs no odd-extent store either: ``Vz``'s top face rides
+every tile's full-minor out-DMA, and the ``Vx``/``Vy`` top slabs (the real
+frozen face plane + 7 junk planes) are carried input→output by two small
+aligned fix-up DMAs (major-dim slab for ``Vx``; 8-aligned second-minor slab
+for ``Vy``).  The outputs are separate buffers (NOT aliased to the inputs:
+a later tile's halo fetch overlaps earlier tiles' owned blocks, so in-place
+writes would feed k-step-advanced values into neighbors' windows).
+
+**Semantics** (matches `models/acoustic3d.py` update for update region and
+frozen set, bit-near-exactly — same constant folding, different FMA
+contraction):
+
+* V update at global-interior faces with global-interior transverse index
+  (the XLA model's ``jnp.pad(dV, 1)`` form); all other faces frozen.
+* P update at ALL cells — including global boundary cells, whose divergence
+  reads the frozen boundary faces (true values, present in the window).
+  Tiles clamped to a global edge therefore compute the physical boundary
+  exactly; for interior tiles validity shrinks one ring per step and owned
+  cells sit ``>= k`` from the window edge (same trapezoid argument as the
+  diffusion kernel).
+
+Structure (flat tile `fori_loop`, double-buffered input DMAs, k-step
+VMEM ping-pong, out-DMA fencing) is inherited from `ops/pallas_stencil.py`
+— see its docstring for the scheduling rationale.
+
+Multi-device: between halo exchanges only ``k=1`` is valid on standard
+``overlap=2`` grids; ``fused_k=k`` in `models.acoustic3d.make_multi_step`
+pairs k kernel steps with one width-``k`` slab exchange of all four fields
+on a deep-halo (``overlap >= 2k``) grid.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+from . import _fused_envelope as _envelope
+
+#: Tile candidates for auto-selection, fastest first (shared heuristics with
+#: the diffusion kernel; the 4-field working set is ~2.4x larger, so the
+#: VMEM check prunes earlier).
+_TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
+
+#: See `ops.pallas_stencil._VMEM_BUDGET_BYTES` (v5e-tuned module constant).
+#: Lower than the diffusion kernel's 100 MiB: Mosaic's real scoped-stack need
+#: exceeds the `_tile_bytes` estimate by ~18% for the 4-field set (probed:
+#: (32,128) k=6 estimated 92 MiB, Mosaic wanted 109 MiB), so the envelope
+#: rejects configs before they reach a Mosaic stack OOM.
+_VMEM_BUDGET_BYTES = 85 * 1024 * 1024
+
+
+def _tile_bytes(n2, k, bx, by, itemsize):
+    """VMEM bytes for one full ping-pong set (4 fields x (2 slots + scratch))."""
+    H = _envelope.aligned_halo(k)
+    SX, SY = bx + 2 * k, by + 2 * H
+    per_set = (
+        SX * SY * n2          # P
+        + (SX + 8) * SY * n2  # Vx
+        + SX * (SY + 8) * n2  # Vy
+        + SX * SY * (n2 + 128)  # Vz (minor pad is a full lane tile)
+    )
+    return 3 * per_set * itemsize
+
+
+def _tile_error(n0, n1, n2, k, bx, by, itemsize):
+    """The validation error a (bx, by) tile would raise, or None if valid."""
+    H = _envelope.aligned_halo(k)
+    vmem_need = _tile_bytes(n2, k, bx, by, itemsize)
+    if vmem_need > _VMEM_BUDGET_BYTES:
+        return (
+            f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of VMEM "
+            f"(12 haloed staggered tiles spanning z; budget "
+            f"{_VMEM_BUDGET_BYTES >> 20} MiB); shrink the tile or k"
+        )
+    if n0 % bx != 0 or n1 % by != 0:
+        return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"
+    if by % 8 != 0 or n1 % 8 != 0:
+        return "by and the y-size must be multiples of 8 (DMA alignment)"
+    if bx + 2 * k > n0 or by + 2 * H > n1:
+        return f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k"
+    return None
+
+
+def default_tile(shape, k: int, itemsize: int = 4):
+    """First tuned tile candidate valid for cell ``shape``, or None."""
+    return _envelope.default_tile(
+        shape, k, itemsize, tile_error=_tile_error, candidates=_TILE_CANDIDATES
+    )
+
+
+def fused_support_error(shape, k: int, itemsize: int = 4,
+                        bx: int | None = None, by: int | None = None) -> str | None:
+    """Why the fused leapfrog kernel cannot run this cell shape, or None.
+
+    Single source of truth for the kernel envelope — used eagerly by
+    `fused_leapfrog_steps` (raise) and by `models.acoustic3d.make_multi_step`
+    (warn once + fall back to the XLA cadence, the reference's
+    runtime-path-selection precedent, `/root/reference/src/update_halo.jl:755-784`).
+    Kernel-independent checks live in `ops/_fused_envelope.py`, shared with
+    the diffusion kernel; only `_tile_error`'s 12-buffer VMEM accounting is
+    specific.
+    """
+    return _envelope.support_error(
+        shape, k, itemsize, bx, by,
+        tile_error=_tile_error, candidates=_TILE_CANDIDATES,
+    )
+
+
+def pad_faces(Vx, Vy, Vz):
+    """Face fields ``(n+1 staggered)`` -> even-extent padded kernel layout.
+
+    Pads each field's own staggered axis with zeros: ``n+1 -> n+8`` for the
+    x/y (major/second-minor) axes, ``n+1 -> n+128`` for z (the minor axis,
+    where Mosaic requires lane-tile-aligned extents).  The extra planes are
+    junk by contract — never read by the kernel's compute, never written
+    back into the real faces.  One HBM pass per field; amortized over a
+    whole fused chunk by the model wrapper.
+    """
+    import jax.numpy as jnp
+
+    return (
+        jnp.pad(Vx, ((0, 7), (0, 0), (0, 0))),
+        jnp.pad(Vy, ((0, 0), (0, 7), (0, 0))),
+        jnp.pad(Vz, ((0, 0), (0, 0), (0, 127))),
+    )
+
+
+def unpad_faces(Vxp, Vyp, Vzp):
+    """Inverse of `pad_faces`: slice the ``n+1`` real faces back out."""
+    return (Vxp[:-7], Vyp[:, :-7], Vzp[:, :, :-127])
+
+
+def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
+                         cax: float, cay: float, caz: float,
+                         b: float, idx: float, idy: float, idz: float,
+                         *, bx: int | None = None, by: int | None = None):
+    """Advance ``k`` (even) leapfrog steps in one HBM pass per field.
+
+    ``P`` is the cell-centered pressure ``(n0, n1, n2)``; ``Vxp/Vyp/Vzp`` are
+    the `pad_faces` layouts of the three staggered velocity fields.
+    Coefficients: ``cax = dt/(rho*dx)`` (likewise y, z); ``b = dt*K``;
+    ``idx = 1/dx`` (likewise y, z) — the same folds as the XLA model so the
+    two paths differ only by FMA contraction.
+    """
+    n0, n1, n2 = P.shape
+    if not (Vxp.shape == (n0 + 8, n1, n2)
+            and Vyp.shape == (n0, n1 + 8, n2)
+            and Vzp.shape == (n0, n1, n2 + 128)):
+        raise ValueError(
+            f"V fields must be in pad_faces layout for P{P.shape}: got "
+            f"{Vxp.shape}, {Vyp.shape}, {Vzp.shape}"
+        )
+    if not (P.dtype == Vxp.dtype == Vyp.dtype == Vzp.dtype):
+        raise ValueError("P and V fields must share a dtype")
+    err = fused_support_error((n0, n1, n2), k, P.dtype.itemsize, bx, by)
+    if err is not None:
+        raise ValueError(err)
+    if bx is None:
+        bx, by = default_tile((n0, n1, n2), k, P.dtype.itemsize)
+    return _build(n0, n1, n2, str(P.dtype), int(k),
+                  float(cax), float(cay), float(caz),
+                  float(b), float(idx), float(idy), float(idz),
+                  int(bx), int(by))(P, Vxp, Vyp, Vzp)
+
+
+@functools.lru_cache(maxsize=64)
+def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    H = 8 * math.ceil(k / 8)
+    SX, SY = bx + 2 * k, by + 2 * H
+    SZ = n2
+    ncx, ncy = n0 // bx, n1 // by
+    ntiles = ncx * ncy
+    dt_ = jnp.dtype(dtype)
+
+    def sx_of(ix):
+        return jnp.clip(ix * bx - k, 0, n0 - SX)
+
+    def sy_of(iy):
+        # Always a multiple of 8 (by, H, n1-SY all are); assert it for Mosaic.
+        return pl.multiple_of(jnp.clip(iy * by - H, 0, n1 - SY), 8)
+
+    # Frozen-region (ring) copies: the complement of each field's update
+    # region, copied once into the scratch buffer (the in-slot buffer holds
+    # it from the DMA; frozen values never change across the k steps).
+    def ring_vx(dst, s):
+        # update region: [1:SX, 1:SY-1, 1:SZ-1]
+        dst[0:1] = s[0:1]
+        dst[SX : SX + 8] = s[SX : SX + 8]
+        dst[1:SX, 0:1] = s[1:SX, 0:1]
+        dst[1:SX, SY - 1 : SY] = s[1:SX, SY - 1 : SY]
+        dst[1:SX, 1 : SY - 1, 0:1] = s[1:SX, 1 : SY - 1, 0:1]
+        dst[1:SX, 1 : SY - 1, SZ - 1 : SZ] = s[1:SX, 1 : SY - 1, SZ - 1 : SZ]
+
+    def ring_vy(dst, s):
+        # update region: [1:SX-1, 1:SY, 1:SZ-1]
+        dst[:, 0:1] = s[:, 0:1]
+        dst[:, SY : SY + 8] = s[:, SY : SY + 8]
+        dst[0:1, 1:SY] = s[0:1, 1:SY]
+        dst[SX - 1 : SX, 1:SY] = s[SX - 1 : SX, 1:SY]
+        dst[1 : SX - 1, 1:SY, 0:1] = s[1 : SX - 1, 1:SY, 0:1]
+        dst[1 : SX - 1, 1:SY, SZ - 1 : SZ] = s[1 : SX - 1, 1:SY, SZ - 1 : SZ]
+
+    def ring_vz(dst, s):
+        # update region: [1:SX-1, 1:SY-1, 1:SZ]
+        dst[:, :, 0:1] = s[:, :, 0:1]
+        dst[:, :, SZ : SZ + 128] = s[:, :, SZ : SZ + 128]
+        dst[0:1, :, 1:SZ] = s[0:1, :, 1:SZ]
+        dst[SX - 1 : SX, :, 1:SZ] = s[SX - 1 : SX, :, 1:SZ]
+        dst[1 : SX - 1, 0:1, 1:SZ] = s[1 : SX - 1, 0:1, 1:SZ]
+        dst[1 : SX - 1, SY - 1 : SY, 1:SZ] = s[1 : SX - 1, SY - 1 : SY, 1:SZ]
+
+    def step_into(dp, dvx, dvy, dvz, sp, svx, svy, svz, ring: bool):
+        """One leapfrog step: (sp, sv*) buffer values -> (dp, dv*) buffers.
+
+        V first (global-interior faces, from old P), then P at ALL window
+        cells from the NEW V — the divergence reads the dst V buffers just
+        written, plus their frozen rows (input values, present via DMA for
+        the in-slot buffers and via the one-time ring copy for scratch).
+        """
+        if ring:
+            ring_vx(dvx, svx)
+            ring_vy(dvy, svy)
+            ring_vz(dvz, svz)
+        P = sp[:]
+        dvx[1:SX, 1 : SY - 1, 1 : SZ - 1] = svx[1:SX, 1 : SY - 1, 1 : SZ - 1] - cax * (
+            P[1:SX, 1 : SY - 1, 1 : SZ - 1] - P[0 : SX - 1, 1 : SY - 1, 1 : SZ - 1]
+        )
+        dvy[1 : SX - 1, 1:SY, 1 : SZ - 1] = svy[1 : SX - 1, 1:SY, 1 : SZ - 1] - cay * (
+            P[1 : SX - 1, 1:SY, 1 : SZ - 1] - P[1 : SX - 1, 0 : SY - 1, 1 : SZ - 1]
+        )
+        dvz[1 : SX - 1, 1 : SY - 1, 1:SZ] = svz[1 : SX - 1, 1 : SY - 1, 1:SZ] - caz * (
+            P[1 : SX - 1, 1 : SY - 1, 1:SZ] - P[1 : SX - 1, 1 : SY - 1, 0 : SZ - 1]
+        )
+        nvx = dvx[0 : SX + 1]
+        nvy = dvy[:, 0 : SY + 1]
+        nvz = dvz[:, :, 0 : SZ + 1]
+        div = (
+            (nvx[1:] - nvx[:-1]) * idx
+            + (nvy[:, 1:] - nvy[:, :-1]) * idy
+            + (nvz[:, :, 1:] - nvz[:, :, :-1]) * idz
+        )
+        dp[:] = P - b * div
+
+    def kernel(Pin, Vxin, Vyin, Vzin, Pout, Vxout, Vyout, Vzout):
+        def body(p, vx, vy, vz, sp, svx, svy, svz,
+                 p_is, vx_is, vy_is, vz_is, p_os, vx_os, vy_os, vz_os, fix_s):
+            def ixy(t):
+                return t // ncy, t % ncy
+
+            def in_dmas(t, slot):
+                ix, iy = ixy(t)
+                sx, sy = sx_of(ix), sy_of(iy)
+                return (
+                    pltpu.make_async_copy(
+                        Pin.at[pl.ds(sx, SX), pl.ds(sy, SY)], p.at[slot], p_is.at[slot]
+                    ),
+                    pltpu.make_async_copy(
+                        Vxin.at[pl.ds(sx, SX + 8), pl.ds(sy, SY)],
+                        vx.at[slot], vx_is.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        Vyin.at[pl.ds(sx, SX), pl.ds(sy, SY + 8)],
+                        vy.at[slot], vy_is.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        Vzin.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        vz.at[slot], vz_is.at[slot],
+                    ),
+                )
+
+            def out_dmas(t, slot):
+                ix, iy = ixy(t)
+                ox = ix * bx - sx_of(ix)
+                oy = pl.multiple_of(iy * by - sy_of(iy), 8)
+                gx, gy = ix * bx, iy * by
+                return (
+                    pltpu.make_async_copy(
+                        p.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Pout.at[pl.ds(gx, bx), pl.ds(gy, by)], p_os.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        vx.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Vxout.at[pl.ds(gx, bx), pl.ds(gy, by)], vx_os.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        vy.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Vyout.at[pl.ds(gx, bx), pl.ds(gy, by)], vy_os.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        vz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Vzout.at[pl.ds(gx, bx), pl.ds(gy, by)], vz_os.at[slot],
+                    ),
+                )
+
+            def start_in(t, slot):
+                for d in in_dmas(t, slot):
+                    d.start()
+
+            def wait_in(t, slot):
+                for d in in_dmas(t, slot):
+                    d.wait()
+
+            def start_out(t, slot):
+                for d in out_dmas(t, slot):
+                    d.start()
+
+            def wait_out(t, slot):
+                for d in out_dmas(t, slot):
+                    d.wait()
+
+            # Top-slab fix-up: the frozen Vx row-n0 / Vy col-n1 face planes
+            # (plus their 7 junk planes) are outside every tile's owned
+            # block — carry them input→output once.  Vz's top face is
+            # covered by the tiles' full-minor out-DMAs.
+            fix_vx = pltpu.make_async_copy(
+                Vxin.at[pl.ds(n0, 8)], Vxout.at[pl.ds(n0, 8)], fix_s.at[0]
+            )
+            fix_vy = pltpu.make_async_copy(
+                Vyin.at[pl.ds(0, n0), pl.ds(n1, 8)],
+                Vyout.at[pl.ds(0, n0), pl.ds(n1, 8)],
+                fix_s.at[1],
+            )
+            fix_vx.start()
+            fix_vy.start()
+            start_in(0, 0)
+
+            def tile(t, _):
+                slot = jax.lax.rem(t, 2)
+                nslot = 1 - slot
+
+                @pl.when(t + 1 < ntiles)
+                def _():
+                    @pl.when(t >= 1)
+                    def _():
+                        # nslot still holds tile t-1's output; fence its
+                        # out-DMAs before prefetching into it.
+                        wait_out(t - 1, nslot)
+
+                    start_in(t + 1, nslot)
+
+                wait_in(t, slot)
+                # k-step ping-pong between the in-slot set and the scratch
+                # set; k even, so the final state lands back in the slot.
+                for j in range(k):
+                    if j % 2 == 0:
+                        step_into(
+                            sp, svx, svy, svz,
+                            p.at[slot], vx.at[slot], vy.at[slot], vz.at[slot],
+                            ring=(j == 0),
+                        )
+                    else:
+                        step_into(
+                            p.at[slot], vx.at[slot], vy.at[slot], vz.at[slot],
+                            sp, svx, svy, svz,
+                            ring=False,
+                        )
+                start_out(t, slot)
+                return 0
+
+            jax.lax.fori_loop(0, ntiles, tile, 0)
+            # Drain the two in-flight out-DMA sets (ntiles >= 2 by
+            # validation; distinct slots).
+            wait_out(ntiles - 2, (ntiles - 2) % 2)
+            wait_out(ntiles - 1, (ntiles - 1) % 2)
+            fix_vx.wait()
+            fix_vy.wait()
+
+        pl.run_scoped(
+            body,
+            p=pltpu.VMEM((2, SX, SY, SZ), dt_),
+            vx=pltpu.VMEM((2, SX + 8, SY, SZ), dt_),
+            vy=pltpu.VMEM((2, SX, SY + 8, SZ), dt_),
+            vz=pltpu.VMEM((2, SX, SY, SZ + 128), dt_),
+            sp=pltpu.VMEM((SX, SY, SZ), dt_),
+            svx=pltpu.VMEM((SX + 8, SY, SZ), dt_),
+            svy=pltpu.VMEM((SX, SY + 8, SZ), dt_),
+            svz=pltpu.VMEM((SX, SY, SZ + 128), dt_),
+            p_is=pltpu.SemaphoreType.DMA((2,)),
+            vx_is=pltpu.SemaphoreType.DMA((2,)),
+            vy_is=pltpu.SemaphoreType.DMA((2,)),
+            vz_is=pltpu.SemaphoreType.DMA((2,)),
+            p_os=pltpu.SemaphoreType.DMA((2,)),
+            vx_os=pltpu.SemaphoreType.DMA((2,)),
+            vy_os=pltpu.SemaphoreType.DMA((2,)),
+            vz_os=pltpu.SemaphoreType.DMA((2,)),
+            fix_s=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n0, n1, n2), dt_),
+            jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),
+            jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
+            jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=min(110 * 1024 * 1024, vmem_bytes + 16 * 1024 * 1024)
+        ),
+    )
+    return jax.jit(call)

@@ -51,6 +51,7 @@ from __future__ import annotations
 import functools
 import math
 
+from . import _fused_envelope as _envelope
 
 #: Tile candidates for auto-selection, fastest first (tuned on v5e; smaller
 #: tiles trade halo-recompute redundancy for fitting smaller volumes).
@@ -67,7 +68,7 @@ _VMEM_BUDGET_BYTES = 100 * 1024 * 1024
 
 def _tile_error(n0, n1, n2, k, bx, by, itemsize):
     """The validation error a (bx, by) tile would raise, or None if valid."""
-    H = 8 * math.ceil(k / 8)
+    H = _envelope.aligned_halo(k)
     vmem_need = 5 * (bx + 2 * k) * (by + 2 * H) * n2 * itemsize
     if vmem_need > _VMEM_BUDGET_BYTES:
         return (
@@ -87,11 +88,9 @@ def _tile_error(n0, n1, n2, k, bx, by, itemsize):
 
 def default_tile(shape, k: int, itemsize: int = 4):
     """First tuned tile candidate valid for ``shape``, or None if none fits."""
-    n0, n1, n2 = shape
-    for bx, by in _TILE_CANDIDATES:
-        if _tile_error(n0, n1, n2, k, bx, by, itemsize) is None:
-            return (bx, by)
-    return None
+    return _envelope.default_tile(
+        shape, k, itemsize, tile_error=_tile_error, candidates=_TILE_CANDIDATES
+    )
 
 
 def fused_support_error(shape, k: int, itemsize: int = 4,
@@ -102,40 +101,15 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     eagerly by `fused_diffusion_steps` (raise) and by
     `models.diffusion3d.make_multi_step` (warn once + fall back to the XLA
     cadence, the reference's runtime-path-selection precedent,
-    `/root/reference/src/update_halo.jl:755-784`).
+    `/root/reference/src/update_halo.jl:755-784`).  Kernel-independent
+    checks (k parity, minor-dim ceiling + lane alignment, tile-selection
+    flow) live in `ops/_fused_envelope.py`, shared with the staggered
+    leapfrog kernel; only `_tile_error`'s VMEM accounting is specific.
     """
-    n0, n1, n2 = shape
-    if k < 2 or k % 2 != 0 or k > 6:
-        return (
-            f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "
-            "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
-            "corrupt tile-corner cells on this toolchain)"
-        )
-    if n2 > 1024:
-        # Bit-level agreement with the XLA path is validated on hardware up
-        # to n2=1024 (an earlier toolchain miscompiled >2-lane-tile tiled
-        # DMAs; the current one is clean, with `pl.multiple_of` alignment
-        # hints on the dynamic offsets).
-        return (
-            f"minor dimension {n2} > 1024 not validated on this toolchain; "
-            "fall back to the XLA path"
-        )
-    if bx is None and by is None:
-        picked = default_tile((n0, n1, n2), k, itemsize)
-        if picked is None:
-            if n1 % 8 != 0:
-                return (
-                    f"y-size {n1} is not a multiple of 8 (DMA sublane "
-                    "alignment); no tile can fit — use the XLA path"
-                )
-            return (
-                f"no tuned tile candidate {_TILE_CANDIDATES} fits volume "
-                f"({n0},{n1},{n2}) with k={k}; pass bx/by explicitly"
-            )
-        return None
-    if bx is None or by is None:
-        return "pass both bx and by, or neither"
-    return _tile_error(n0, n1, n2, k, bx, by, itemsize)
+    return _envelope.support_error(
+        shape, k, itemsize, bx, by,
+        tile_error=_tile_error, candidates=_TILE_CANDIDATES,
+    )
 
 
 def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,

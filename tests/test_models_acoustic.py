@@ -138,3 +138,97 @@ def test_random_overlap_staggered_invariance(seed):
             err_msg=f"{name} o={o} nx={nx} nt={nt}",
         )
     igg.finalize_global_grid()
+
+
+def test_fused_single_device_matches_xla():
+    """fused_k on a no-halo-activity grid (1 device): the padded-layout
+    staggered kernel chunk must match the per-step XLA path to few f32 ULPs
+    (interpret-mode kernel)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 4
+    kw = dict(devices=jax.devices()[:1], quiet=True)
+    state, params = acoustic3d.setup(16, 32, 128, **kw)
+    step = acoustic3d.make_multi_step(params, nt, donate=False)
+    ref = [np.asarray(A) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = acoustic3d.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = acoustic3d.make_multi_step(
+            params, nt, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+        got = [np.asarray(A) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_deep_halo_matches_xla_multiblock():
+    """Temporal blocking on a communicating STAGGERED grid: k fused kernel
+    steps + one width-k all-field slab exchange vs the per-step path
+    (interpret-mode kernel; deep halo overlapx=4 licenses fused_k=2).
+
+    2 devices deliberately — the interpret-mode Pallas + shard_map deadlock
+    constraint probed for the diffusion kernel applies here too."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 4
+    kw = dict(
+        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, overlapx=4, quiet=True
+    )
+    state, params = acoustic3d.setup(16, 32, 128, **kw)
+    step = acoustic3d.make_multi_step(params, nt, donate=False)
+    ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = acoustic3d.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = acoustic3d.make_multi_step(
+            params, nt, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+        got = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_fallback_warns_and_matches_xla():
+    """A local block the kernel envelope rejects (y-size not a multiple of 8)
+    must warn once and run the XLA path at the same all-field slab cadence —
+    bit-identical to the per-step path at group boundaries."""
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    state, params = acoustic3d.setup(10, 10, 10, **kw)
+    step = acoustic3d.make_multi_step(params, 4, donate=False)
+    ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = acoustic3d.setup(10, 10, 10, **kw)
+    with pytest.warns(RuntimeWarning, match="falling back to the XLA path"):
+        stepf = acoustic3d.make_multi_step(params, 4, donate=False, fused_k=2)
+        got = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(g, r)
+
+
+def test_fused_validation():
+    state, params = acoustic3d.setup(
+        16, 32, 128, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, quiet=True
+    )
+    with pytest.raises(ValueError, match="deep halo"):
+        acoustic3d.make_multi_step(params, 4, fused_k=2)
+    igg.finalize_global_grid()
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    state, params = acoustic3d.setup(10, 10, 10, **kw)
+    with pytest.raises(ValueError, match="multiple of fused_k"):
+        acoustic3d.make_multi_step(params, 5, fused_k=2)
+    with pytest.raises(ValueError, match="pass both bx and by"):
+        acoustic3d.make_multi_step(params, 4, fused_k=2, fused_tile=(8, None))
+    with pytest.raises(ValueError, match="conflicts"):
+        acoustic3d.make_multi_step(params, 4, fused_k=2, exchange_every=4)
+    igg.finalize_global_grid()
+    state, params = acoustic3d.setup(10, 10, 10, hide_comm=True, **kw)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        acoustic3d.make_multi_step(params, 4, fused_k=2)
+    igg.finalize_global_grid()
