@@ -128,6 +128,23 @@ def _time_steps(step, state, chunk: int, reps: int):
     return t_it, state
 
 
+def _fused_provenance(fused_k, support_error, local_shape, itemsize, fused_tile):
+    """Metric suffix + path record for a ``fused_k`` request.
+
+    Deterministic provenance (same envelope check the model's fallback
+    uses): a config the kernel envelope rejects ran the warn-once XLA
+    cadence, and the emitted metric name must say so — otherwise an XLA
+    number gets recorded under a fused-kernel label.
+    """
+    if not fused_k:
+        return "", None
+    bx, by = fused_tile if fused_tile is not None else (None, None)
+    err = support_error(tuple(local_shape), fused_k, itemsize, bx, by)
+    if err is None:
+        return f"_fused{fused_k}", "pallas-fused"
+    return f"_fused{fused_k}fb", "xla-fallback"
+
+
 def _emit(name, teff, t_it, extra=None, emit=True):
     rec = {
         "metric": name,
@@ -171,18 +188,27 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         params, chunk, donate=False, fused_k=fused_k, fused_tile=fused_tile,
         exchange_every=exchange_every,
     )
+    from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
+
+    fsuf, fpath = _fused_provenance(
+        fused_k, fused_support_error, igg.local_shape(state[0]),
+        jax.numpy.dtype(dtype).itemsize, fused_tile,
+    )
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     nbytes = 2 * n**3 * jax.numpy.dtype(dtype).itemsize
+    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs}
+    if fpath:
+        extra["path"] = fpath
     return _emit(
         f"diffusion3d_{n}_{dtype}"
         + ("_overlap" if hide_comm else "")
-        + (f"_fused{fused_k}" if fused_k else "")
+        + fsuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
-        {"dims": list(gg.dims), "nprocs": gg.nprocs},
+        extra,
         emit=emit,
     )
 
@@ -211,27 +237,38 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         params, chunk, donate=False, exchange_every=exchange_every,
         fused_k=fused_k, fused_tile=fused_tile,
     )
+    from implicitglobalgrid_tpu.ops.pallas_leapfrog import fused_support_error
+
+    fsuf, fpath = _fused_provenance(
+        fused_k, fused_support_error, igg.local_shape(state[0]),
+        jax.numpy.dtype(dtype).itemsize, fused_tile,
+    )
     t_it, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize  # P,Vx,Vy,Vz in+out
+    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs}
+    if fpath:
+        extra["path"] = fpath
     return _emit(
         f"acoustic3d_{n}_{dtype}"
         + ("_overlap" if hide_comm else "")
-        + (f"_fused{fused_k}" if fused_k else "")
+        + fsuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
-        {"dims": list(gg.dims), "nprocs": gg.nprocs},
+        extra,
         emit=emit,
     )
 
 
 def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
-                 emit=True, exchange_every=1, overlap=None):
+                 emit=True, exchange_every=1, overlap=None, fused_k=None,
+                 fused_tile=None):
     """``chunk`` whole time steps (= ``chunk*npt`` PT iterations) per call via
     `porous_convection3d.make_multi_step` — one XLA program, like the other
-    models' production paths."""
+    models' production paths.  ``fused_k``: the temporally-blocked PT kernel
+    (`ops/pallas_pt.py`; needs ``n % 128 == 0`` — use ``--n 256``)."""
     import jax
 
     import implicitglobalgrid_tpu as igg
@@ -247,7 +284,14 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
         **okw,
     )
     step = pc.make_multi_step(
-        params, chunk, donate=False, exchange_every=exchange_every
+        params, chunk, donate=False, exchange_every=exchange_every,
+        fused_k=fused_k, fused_tile=fused_tile,
+    )
+    from implicitglobalgrid_tpu.ops.pallas_pt import fused_support_error
+
+    fsuf, fpath = _fused_provenance(
+        fused_k, fused_support_error, igg.local_shape(state[0]),
+        jax.numpy.dtype(dtype).itemsize, fused_tile,
     )
     t_step, state = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
@@ -255,12 +299,16 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     # Per PT iteration: qDx,qDy,qDz,Pf in+out = 8 array passes.
     t_pt = t_step / npt
     nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize
+    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs, "t_pt_ms": round(t_pt * 1e3, 4)}
+    if fpath:
+        extra["path"] = fpath
     return _emit(
         f"porous_convection3d_{n}_{dtype}_npt{npt}"
+        + fsuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_pt / 1e9,
         t_step,
-        {"dims": list(gg.dims), "nprocs": gg.nprocs, "t_pt_ms": round(t_pt * 1e3, 4)},
+        extra,
         emit=emit,
     )
 
@@ -339,8 +387,9 @@ def main():
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly
         porous_chunk = a.chunk if a.what == "porous" else 4
-        bench_porous(n=a.n or 128, chunk=porous_chunk, reps=a.reps, npt=a.npt,
-                     dtype=a.dtype, exchange_every=a.exchange_every, overlap=a.overlap)
+        bench_porous(n=a.n or (256 if a.fused_k else 128), chunk=porous_chunk,
+                     reps=a.reps, npt=a.npt, dtype=a.dtype, fused_k=a.fused_k,
+                     exchange_every=a.exchange_every, overlap=a.overlap)
     if a.what in ("weak", "all"):
         bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,
                            dtype=a.dtype, hide_comm=a.hide_comm)

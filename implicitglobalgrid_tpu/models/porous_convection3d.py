@@ -312,6 +312,8 @@ def make_multi_step(
     *,
     donate: bool = True,
     exchange_every: int = 1,
+    fused_k: int | None = None,
+    fused_tile: tuple[int, int] | None = None,
 ):
     """Advance ``nsteps`` time steps per call in ONE XLA program
     (`lax.fori_loop` over whole time steps) — the production path: per-call
@@ -332,6 +334,18 @@ def make_multi_step(
     differently-fused programs round differently).  Requires
     ``npt % w == 0``.
 
+    ``fused_k=w``: run the ``w`` PT iterations between slab exchanges inside
+    the temporally-blocked Pallas kernel (`ops/pallas_pt.py`) — one HBM pass
+    per field per ``w`` iterations instead of ``w`` read/write sweeps, the
+    porous sibling of the diffusion/acoustic ``fused_k`` levers.  Same
+    cadence semantics as ``exchange_every=w`` (deep halo ``overlap >= 2w``,
+    all-four-field width-``w`` slab exchange per group, ``npt % w == 0``);
+    local blocks the kernel envelope rejects warn once and run the XLA
+    cadence instead.  On grids with no halo activity the fluxes stay in the
+    kernel's padded layout across the whole PT loop (pad/unpad once per time
+    step); on communicating grids each group pays one pad/unpad of the three
+    flux fields around the slab exchange.
+
     Loop structure chosen by measurement on v5e (160^3 f32, npt=10): the
     per-step PT loop stays a `lax.fori_loop`, the outer time-step loop is
     unrolled in Python INSIDE the one program — nesting it as a second
@@ -346,9 +360,122 @@ def make_multi_step(
     p_update = _pressure_update(params)
     npt = params.npt
 
-    if exchange_every < 1:
+    if fused_k:
+        import jax
+
+        from ..ops.halo import dim_has_halo_activity, require_deep_halo
+        from ..ops.pallas_pt import (
+            fused_pt_iterations,
+            fused_support_error,
+            pad_faces,
+            unpad_faces,
+        )
+        from ..parallel.grid import global_grid
+        from ._fused import warn_fused_fallback
+
+        gg = global_grid()
+        if params.hide_comm:
+            raise ValueError(
+                "fused_k and hide_comm are mutually exclusive: the fused "
+                "kernel's slab exchange is already amortized over k "
+                "iterations; overlap scheduling applies to the per-iteration "
+                "XLA path."
+            )
+        if npt % fused_k != 0:
+            raise ValueError(f"npt={npt} must be a multiple of fused_k={fused_k}")
+        if exchange_every not in (1, fused_k):
+            raise ValueError(
+                f"fused_k={fused_k} already exchanges every fused_k PT "
+                f"iterations; exchange_every={exchange_every} conflicts."
+            )
+        require_deep_halo(fused_k, gg, what="fused_k")
+        active = [d for d in range(3) if dim_has_halo_activity(gg, d)]
+        w = fused_k
+        th = params.theta_q
+        idx, idy, idz = 1.0 / params.dx, 1.0 / params.dy, 1.0 / params.dz
+        ralam = params.Ra * params.lam_T
+        bp = params.beta_p
+        bx, by = fused_tile if fused_tile is not None else (None, None)
+        if (bx is None) != (by is None):
+            raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
+
+        def kernel_iters(T, Pf, qxp, qyp, qzp):
+            return fused_pt_iterations(
+                T, Pf, qxp, qyp, qzp, w, th, idx, idy, idz, ralam, bp,
+                bx=bx, by=by,
+            )
+
+        def xla_group(T, s):
+            Pf, qDx, qDy, qDz = s
+            for _ in range(w):
+                qDx, qDy, qDz = flux_update(T, Pf, qDx, qDy, qDz)
+                Pf = p_update(Pf, qDx, qDy, qDz)
+            return Pf, qDx, qDy, qDz
+
+        if not active:
+
+            def fused_block_step(T, Pf, qDx, qDy, qDz):
+                # Fluxes stay padded across the whole PT loop (no exchange
+                # to serve); the no-op update_halo calls are skipped too.
+                qxp, qyp, qzp = pad_faces(qDx, qDy, qDz)
+
+                def group(i, s):
+                    return kernel_iters(T, *s)
+
+                Pf, qxp, qyp, qzp = lax.fori_loop(
+                    0, npt // w, group, (Pf, qxp, qyp, qzp)
+                )
+                qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
+                T = t_update(T, qDx, qDy, qDz)
+                return T, Pf, qDx, qDy, qDz
+
+        else:
+
+            def fused_block_step(T, Pf, qDx, qDy, qDz):
+                def group(i, s):
+                    Pf, qDx, qDy, qDz = s
+                    qxp, qyp, qzp = pad_faces(qDx, qDy, qDz)
+                    Pf, qxp, qyp, qzp = kernel_iters(T, Pf, qxp, qyp, qzp)
+                    qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
+                    # All four PT fields slab-exchange (the fluxes' rind
+                    # relaxation history is stale — see exchange_every).
+                    return update_halo(Pf, qDx, qDy, qDz, width=w)
+
+                Pf, qDx, qDy, qDz = lax.fori_loop(
+                    0, npt // w, group, (Pf, qDx, qDy, qDz)
+                )
+                T = t_update(T, qDx, qDy, qDz)
+                T = update_halo(T)
+                return T, Pf, qDx, qDy, qDz
+
+        def xla_block_step(T, Pf, qDx, qDy, qDz):
+            def group(i, s):
+                s = xla_group(T, s)
+                if active:
+                    return update_halo(*s, width=w)
+                return s
+
+            Pf, qDx, qDy, qDz = lax.fori_loop(
+                0, npt // w, group, (Pf, qDx, qDy, qDz)
+            )
+            T = t_update(T, qDx, qDy, qDz)
+            if active:
+                T = update_halo(T)
+            return T, Pf, qDx, qDy, qDz
+
+        def block_step(T, Pf, qDx, qDy, qDz):
+            # Shapes are only known at trace time, so the kernel-vs-fallback
+            # choice happens there (the reference's runtime-path-selection
+            # move, `/root/reference/src/update_halo.jl:755-784`).
+            err = fused_support_error(tuple(Pf.shape), w, Pf.dtype.itemsize, bx, by)
+            if err is None:
+                return fused_block_step(T, Pf, qDx, qDy, qDz)
+            warn_fused_fallback(tuple(Pf.shape), w, err, model="porous")
+            return xla_block_step(T, Pf, qDx, qDy, qDz)
+
+    elif exchange_every < 1:
         raise ValueError(f"exchange_every must be >= 1 (got {exchange_every})")
-    if exchange_every > 1:
+    elif exchange_every > 1:
         from ..ops.halo import require_deep_halo
 
         if params.hide_comm:

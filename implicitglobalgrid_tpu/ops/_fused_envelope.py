@@ -26,6 +26,34 @@ def aligned_halo(k: int) -> int:
     return 8 * math.ceil(k / 8)
 
 
+def make_tile_error(tile_bytes, budget, desc):
+    """Build a kernel's ``tile_error`` from its VMEM accounting.
+
+    ``tile_bytes(n2, k, bx, by, itemsize)`` is the kernel-specific working
+    set; ``desc`` names it in the rejection message.  Everything else
+    (divisibility, sublane alignment, haloed-tile fit) is kernel-independent
+    and lives here once.
+    """
+
+    def tile_error(n0, n1, n2, k, bx, by, itemsize):
+        H = aligned_halo(k)
+        vmem_need = tile_bytes(n2, k, bx, by, itemsize)
+        if vmem_need > budget:
+            return (
+                f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of "
+                f"VMEM ({desc}; budget {budget >> 20} MiB); shrink the tile or k"
+            )
+        if n0 % bx != 0 or n1 % by != 0:
+            return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"
+        if by % 8 != 0 or n1 % 8 != 0:
+            return "by and the y-size must be multiples of 8 (DMA alignment)"
+        if bx + 2 * k > n0 or by + 2 * H > n1:
+            return f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k"
+        return None
+
+    return tile_error
+
+
 def default_tile(shape, k, itemsize, *, tile_error, candidates):
     """First candidate ``tile_error`` accepts for ``shape``, or None."""
     n0, n1, n2 = shape

@@ -66,7 +66,6 @@ on a deep-halo (``overlap >= 2k``) grid.
 from __future__ import annotations
 
 import functools
-import math
 
 from . import _fused_envelope as _envelope
 
@@ -96,23 +95,9 @@ def _tile_bytes(n2, k, bx, by, itemsize):
     return 3 * per_set * itemsize
 
 
-def _tile_error(n0, n1, n2, k, bx, by, itemsize):
-    """The validation error a (bx, by) tile would raise, or None if valid."""
-    H = _envelope.aligned_halo(k)
-    vmem_need = _tile_bytes(n2, k, bx, by, itemsize)
-    if vmem_need > _VMEM_BUDGET_BYTES:
-        return (
-            f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of VMEM "
-            f"(12 haloed staggered tiles spanning z; budget "
-            f"{_VMEM_BUDGET_BYTES >> 20} MiB); shrink the tile or k"
-        )
-    if n0 % bx != 0 or n1 % by != 0:
-        return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"
-    if by % 8 != 0 or n1 % 8 != 0:
-        return "by and the y-size must be multiples of 8 (DMA alignment)"
-    if bx + 2 * k > n0 or by + 2 * H > n1:
-        return f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k"
-    return None
+_tile_error = _envelope.make_tile_error(
+    _tile_bytes, _VMEM_BUDGET_BYTES, "12 haloed staggered tiles spanning z"
+)
 
 
 def default_tile(shape, k: int, itemsize: int = 4):
@@ -204,7 +189,7 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    H = 8 * math.ceil(k / 8)
+    H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     SZ = n2
     ncx, ncy = n0 // bx, n1 // by

@@ -1,0 +1,364 @@
+"""Pallas TPU kernel: temporally-blocked fused pseudo-transient iterations.
+
+The porous-convection sibling of `ops/pallas_leapfrog.py`: advance ``w``
+Darcy flux / fluid pressure relaxation iterations of the PT inner solver
+(`models/porous_convection3d.py` — the HydroMech weak-scaling flagship,
+BASELINE config 4) in ONE HBM round trip per field.  Structurally the PT
+iteration IS a staggered leapfrog — flux update at interior faces (a
+relaxation toward ``-grad(Pf)`` plus buoyancy on z-faces), pressure update
+at ALL cells from the fresh fluxes — so the even-extent padded face layout
+(`pad_faces`), the tile/window geometry, the frozen-top-face fix-up DMAs,
+the trapezoid validity argument, and the envelope checks are all inherited
+from the leapfrog kernel (see its module docstring; the kernel body is
+deliberately mirrored rather than abstracted over — the compute formulas and
+buffer sets differ, and the DMA scaffolding is the delicate, hardware-
+validated part that benefits from staying literal).
+
+Differences from the leapfrog kernel:
+
+* One extra **read-only** cell-shaped input ``T`` (temperature, frozen
+  across the whole PT loop): double-buffered input DMAs like the diffusion
+  kernel's ``Cp``, no scratch, no output.  Its window values are exact
+  everywhere (no shrinkage), so the buoyancy term reads them at any step.
+* Flux update: ``q <- q + th*(f - q)`` with ``f = -dPf*id`` (plus
+  ``RaLam * av_z(T)`` on z-faces) instead of the leapfrog increment.
+* Pressure update: ``Pf <- Pf - bp*div(q)`` — same all-cells form.
+
+Semantics match `models/porous_convection3d.py`'s `_flux_update` +
+`_pressure_update` pair for update regions and frozen sets, to a few f32
+ULPs (the kernel multiplies by precomputed ``1/dx`` where the XLA path
+divides; same stencil, different rounding).
+
+Multi-device: ``fused_k=w`` in `porous_convection3d.make_multi_step` is the
+kernel-accelerated version of its ``exchange_every=w`` deep-halo cadence —
+w kernel iterations per width-``w`` all-field slab exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from . import _fused_envelope as _envelope
+from .pallas_leapfrog import pad_faces, unpad_faces  # noqa: F401  (re-export)
+
+_TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
+
+#: See `ops.pallas_leapfrog._VMEM_BUDGET_BYTES` (Mosaic's scoped stack runs
+#: ~18% past the buffer-byte estimate on the staggered sets).
+_VMEM_BUDGET_BYTES = 85 * 1024 * 1024
+
+
+def _tile_bytes(n2, k, bx, by, itemsize):
+    """VMEM bytes: 4 ping-pong fields x (2 slots + scratch) + 2 T slots."""
+    H = _envelope.aligned_halo(k)
+    SX, SY = bx + 2 * k, by + 2 * H
+    per_set = (
+        SX * SY * n2            # Pf
+        + (SX + 8) * SY * n2    # qDx
+        + SX * (SY + 8) * n2    # qDy
+        + SX * SY * (n2 + 128)  # qDz
+    )
+    return (3 * per_set + 2 * SX * SY * n2) * itemsize
+
+
+_tile_error = _envelope.make_tile_error(
+    _tile_bytes, _VMEM_BUDGET_BYTES, "14 haloed staggered tiles spanning z"
+)
+
+
+def default_tile(shape, k: int, itemsize: int = 4):
+    """First tuned tile candidate valid for cell ``shape``, or None."""
+    return _envelope.default_tile(
+        shape, k, itemsize, tile_error=_tile_error, candidates=_TILE_CANDIDATES
+    )
+
+
+def fused_support_error(shape, k: int, itemsize: int = 4,
+                        bx: int | None = None, by: int | None = None) -> str | None:
+    """Why the fused PT kernel cannot run this cell shape, or None.
+
+    Shared control flow in `ops/_fused_envelope.py`; only `_tile_error`'s
+    14-buffer VMEM accounting is specific.
+    """
+    return _envelope.support_error(
+        shape, k, itemsize, bx, by,
+        tile_error=_tile_error, candidates=_TILE_CANDIDATES,
+    )
+
+
+def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
+                        th: float, idx: float, idy: float, idz: float,
+                        ralam: float, bp: float,
+                        *, bx: int | None = None, by: int | None = None):
+    """Advance ``k`` (even) PT relaxation iterations in one HBM pass per field.
+
+    ``T``/``Pf`` are cell-centered ``(n0, n1, n2)``; ``qxp/qyp/qzp`` are the
+    `pad_faces` layouts of the staggered Darcy fluxes.  Coefficients:
+    ``th`` = flux relaxation, ``idx = 1/dx`` (likewise y, z), ``ralam =
+    Ra*lam_T`` (buoyancy), ``bp`` = pressure relaxation.  Returns
+    ``(Pf, qxp, qyp, qzp)`` — ``T`` is read-only.
+    """
+    n0, n1, n2 = Pf.shape
+    if T.shape != Pf.shape:
+        raise ValueError(f"T{T.shape} and Pf{Pf.shape} must share the cell shape")
+    if not (qxp.shape == (n0 + 8, n1, n2)
+            and qyp.shape == (n0, n1 + 8, n2)
+            and qzp.shape == (n0, n1, n2 + 128)):
+        raise ValueError(
+            f"flux fields must be in pad_faces layout for Pf{Pf.shape}: got "
+            f"{qxp.shape}, {qyp.shape}, {qzp.shape}"
+        )
+    if not (T.dtype == Pf.dtype == qxp.dtype == qyp.dtype == qzp.dtype):
+        raise ValueError("T, Pf and flux fields must share a dtype")
+    err = fused_support_error((n0, n1, n2), k, Pf.dtype.itemsize, bx, by)
+    if err is not None:
+        raise ValueError(err)
+    if bx is None:
+        bx, by = default_tile((n0, n1, n2), k, Pf.dtype.itemsize)
+    return _build(n0, n1, n2, str(Pf.dtype), int(k),
+                  float(th), float(idx), float(idy), float(idz),
+                  float(ralam), float(bp), int(bx), int(by))(T, Pf, qxp, qyp, qzp)
+
+
+@functools.lru_cache(maxsize=64)
+def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    H = _envelope.aligned_halo(k)
+    SX, SY = bx + 2 * k, by + 2 * H
+    SZ = n2
+    ncx, ncy = n0 // bx, n1 // by
+    ntiles = ncx * ncy
+    dt_ = jnp.dtype(dtype)
+
+    def sx_of(ix):
+        return jnp.clip(ix * bx - k, 0, n0 - SX)
+
+    def sy_of(iy):
+        return pl.multiple_of(jnp.clip(iy * by - H, 0, n1 - SY), 8)
+
+    # Frozen-region copies: identical regions to the leapfrog kernel (the
+    # flux update regions match the velocity ones; Pf updates all cells).
+    def ring_qx(dst, s):
+        dst[0:1] = s[0:1]
+        dst[SX : SX + 8] = s[SX : SX + 8]
+        dst[1:SX, 0:1] = s[1:SX, 0:1]
+        dst[1:SX, SY - 1 : SY] = s[1:SX, SY - 1 : SY]
+        dst[1:SX, 1 : SY - 1, 0:1] = s[1:SX, 1 : SY - 1, 0:1]
+        dst[1:SX, 1 : SY - 1, SZ - 1 : SZ] = s[1:SX, 1 : SY - 1, SZ - 1 : SZ]
+
+    def ring_qy(dst, s):
+        dst[:, 0:1] = s[:, 0:1]
+        dst[:, SY : SY + 8] = s[:, SY : SY + 8]
+        dst[0:1, 1:SY] = s[0:1, 1:SY]
+        dst[SX - 1 : SX, 1:SY] = s[SX - 1 : SX, 1:SY]
+        dst[1 : SX - 1, 1:SY, 0:1] = s[1 : SX - 1, 1:SY, 0:1]
+        dst[1 : SX - 1, 1:SY, SZ - 1 : SZ] = s[1 : SX - 1, 1:SY, SZ - 1 : SZ]
+
+    def ring_qz(dst, s):
+        dst[:, :, 0:1] = s[:, :, 0:1]
+        dst[:, :, SZ : SZ + 128] = s[:, :, SZ : SZ + 128]
+        dst[0:1, :, 1:SZ] = s[0:1, :, 1:SZ]
+        dst[SX - 1 : SX, :, 1:SZ] = s[SX - 1 : SX, :, 1:SZ]
+        dst[1 : SX - 1, 0:1, 1:SZ] = s[1 : SX - 1, 0:1, 1:SZ]
+        dst[1 : SX - 1, SY - 1 : SY, 1:SZ] = s[1 : SX - 1, SY - 1 : SY, 1:SZ]
+
+    def step_into(dp, dqx, dqy, dqz, sp, sqx, sqy, sqz, tv, ring: bool):
+        """One PT iteration: (sp, sq*) buffers -> (dp, dq*) buffers.
+
+        ``tv`` is the tile's (frozen) temperature value.  Fluxes first
+        (relaxation toward -grad(Pf), buoyancy on z), then Pf at ALL cells
+        from the fresh fluxes.
+        """
+        if ring:
+            ring_qx(dqx, sqx)
+            ring_qy(dqy, sqy)
+            ring_qz(dqz, sqz)
+        P = sp[:]
+        fx = -idx * (P[1:SX, 1 : SY - 1, 1 : SZ - 1] - P[0 : SX - 1, 1 : SY - 1, 1 : SZ - 1])
+        q = sqx[1:SX, 1 : SY - 1, 1 : SZ - 1]
+        dqx[1:SX, 1 : SY - 1, 1 : SZ - 1] = q + th * (fx - q)
+        fy = -idy * (P[1 : SX - 1, 1:SY, 1 : SZ - 1] - P[1 : SX - 1, 0 : SY - 1, 1 : SZ - 1])
+        q = sqy[1 : SX - 1, 1:SY, 1 : SZ - 1]
+        dqy[1 : SX - 1, 1:SY, 1 : SZ - 1] = q + th * (fy - q)
+        # z-faces carry buoyancy: Ra*lam_T * (T averaged onto the face).
+        tz = 0.5 * (tv[1 : SX - 1, 1 : SY - 1, 1:SZ] + tv[1 : SX - 1, 1 : SY - 1, 0 : SZ - 1])
+        fz = (
+            -idz * (P[1 : SX - 1, 1 : SY - 1, 1:SZ] - P[1 : SX - 1, 1 : SY - 1, 0 : SZ - 1])
+            + ralam * tz
+        )
+        q = sqz[1 : SX - 1, 1 : SY - 1, 1:SZ]
+        dqz[1 : SX - 1, 1 : SY - 1, 1:SZ] = q + th * (fz - q)
+        nqx = dqx[0 : SX + 1]
+        nqy = dqy[:, 0 : SY + 1]
+        nqz = dqz[:, :, 0 : SZ + 1]
+        div = (
+            (nqx[1:] - nqx[:-1]) * idx
+            + (nqy[:, 1:] - nqy[:, :-1]) * idy
+            + (nqz[:, :, 1:] - nqz[:, :, :-1]) * idz
+        )
+        dp[:] = P - bp * div
+
+    def kernel(Tin, Pfin, Qxin, Qyin, Qzin, Pfout, Qxout, Qyout, Qzout):
+        def body(t, p, qx, qy, qz, sp, sqx, sqy, sqz,
+                 t_is, p_is, qx_is, qy_is, qz_is,
+                 p_os, qx_os, qy_os, qz_os, fix_s):
+            def ixy(tt):
+                return tt // ncy, tt % ncy
+
+            def in_dmas(tt, slot):
+                ix, iy = ixy(tt)
+                sx, sy = sx_of(ix), sy_of(iy)
+                return (
+                    pltpu.make_async_copy(
+                        Tin.at[pl.ds(sx, SX), pl.ds(sy, SY)], t.at[slot], t_is.at[slot]
+                    ),
+                    pltpu.make_async_copy(
+                        Pfin.at[pl.ds(sx, SX), pl.ds(sy, SY)], p.at[slot], p_is.at[slot]
+                    ),
+                    pltpu.make_async_copy(
+                        Qxin.at[pl.ds(sx, SX + 8), pl.ds(sy, SY)],
+                        qx.at[slot], qx_is.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        Qyin.at[pl.ds(sx, SX), pl.ds(sy, SY + 8)],
+                        qy.at[slot], qy_is.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        Qzin.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        qz.at[slot], qz_is.at[slot],
+                    ),
+                )
+
+            def out_dmas(tt, slot):
+                ix, iy = ixy(tt)
+                ox = ix * bx - sx_of(ix)
+                oy = pl.multiple_of(iy * by - sy_of(iy), 8)
+                gx, gy = ix * bx, iy * by
+                return (
+                    pltpu.make_async_copy(
+                        p.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Pfout.at[pl.ds(gx, bx), pl.ds(gy, by)], p_os.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        qx.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Qxout.at[pl.ds(gx, bx), pl.ds(gy, by)], qx_os.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        qy.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Qyout.at[pl.ds(gx, bx), pl.ds(gy, by)], qy_os.at[slot],
+                    ),
+                    pltpu.make_async_copy(
+                        qz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        Qzout.at[pl.ds(gx, bx), pl.ds(gy, by)], qz_os.at[slot],
+                    ),
+                )
+
+            def start_in(tt, slot):
+                for d in in_dmas(tt, slot):
+                    d.start()
+
+            def wait_in(tt, slot):
+                for d in in_dmas(tt, slot):
+                    d.wait()
+
+            def start_out(tt, slot):
+                for d in out_dmas(tt, slot):
+                    d.start()
+
+            def wait_out(tt, slot):
+                for d in out_dmas(tt, slot):
+                    d.wait()
+
+            # Frozen top-slab fix-up (see the leapfrog kernel): Qx row-n0 and
+            # Qy col-n1 planes; Qz's top face rides the full-minor out-DMAs.
+            fix_qx = pltpu.make_async_copy(
+                Qxin.at[pl.ds(n0, 8)], Qxout.at[pl.ds(n0, 8)], fix_s.at[0]
+            )
+            fix_qy = pltpu.make_async_copy(
+                Qyin.at[pl.ds(0, n0), pl.ds(n1, 8)],
+                Qyout.at[pl.ds(0, n0), pl.ds(n1, 8)],
+                fix_s.at[1],
+            )
+            fix_qx.start()
+            fix_qy.start()
+            start_in(0, 0)
+
+            def tile(tt, _):
+                slot = jax.lax.rem(tt, 2)
+                nslot = 1 - slot
+
+                @pl.when(tt + 1 < ntiles)
+                def _():
+                    @pl.when(tt >= 1)
+                    def _():
+                        wait_out(tt - 1, nslot)
+
+                    start_in(tt + 1, nslot)
+
+                wait_in(tt, slot)
+                tv = t[slot]
+                for j in range(k):
+                    if j % 2 == 0:
+                        step_into(
+                            sp, sqx, sqy, sqz,
+                            p.at[slot], qx.at[slot], qy.at[slot], qz.at[slot],
+                            tv, ring=(j == 0),
+                        )
+                    else:
+                        step_into(
+                            p.at[slot], qx.at[slot], qy.at[slot], qz.at[slot],
+                            sp, sqx, sqy, sqz,
+                            tv, ring=False,
+                        )
+                start_out(tt, slot)
+                return 0
+
+            jax.lax.fori_loop(0, ntiles, tile, 0)
+            wait_out(ntiles - 2, (ntiles - 2) % 2)
+            wait_out(ntiles - 1, (ntiles - 1) % 2)
+            fix_qx.wait()
+            fix_qy.wait()
+
+        pl.run_scoped(
+            body,
+            t=pltpu.VMEM((2, SX, SY, SZ), dt_),
+            p=pltpu.VMEM((2, SX, SY, SZ), dt_),
+            qx=pltpu.VMEM((2, SX + 8, SY, SZ), dt_),
+            qy=pltpu.VMEM((2, SX, SY + 8, SZ), dt_),
+            qz=pltpu.VMEM((2, SX, SY, SZ + 128), dt_),
+            sp=pltpu.VMEM((SX, SY, SZ), dt_),
+            sqx=pltpu.VMEM((SX + 8, SY, SZ), dt_),
+            sqy=pltpu.VMEM((SX, SY + 8, SZ), dt_),
+            sqz=pltpu.VMEM((SX, SY, SZ + 128), dt_),
+            t_is=pltpu.SemaphoreType.DMA((2,)),
+            p_is=pltpu.SemaphoreType.DMA((2,)),
+            qx_is=pltpu.SemaphoreType.DMA((2,)),
+            qy_is=pltpu.SemaphoreType.DMA((2,)),
+            qz_is=pltpu.SemaphoreType.DMA((2,)),
+            p_os=pltpu.SemaphoreType.DMA((2,)),
+            qx_os=pltpu.SemaphoreType.DMA((2,)),
+            qy_os=pltpu.SemaphoreType.DMA((2,)),
+            qz_os=pltpu.SemaphoreType.DMA((2,)),
+            fix_s=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n0, n1, n2), dt_),
+            jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),
+            jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
+            jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=min(110 * 1024 * 1024, vmem_bytes + 16 * 1024 * 1024)
+        ),
+    )
+    return jax.jit(call)

@@ -49,7 +49,6 @@ exchanges requires ``overlap >= 2k`` halos.
 from __future__ import annotations
 
 import functools
-import math
 
 from . import _fused_envelope as _envelope
 
@@ -66,24 +65,17 @@ _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 100 * 1024 * 1024
 
 
-def _tile_error(n0, n1, n2, k, bx, by, itemsize):
-    """The validation error a (bx, by) tile would raise, or None if valid."""
+def _tile_bytes(n2, k, bx, by, itemsize):
+    """VMEM bytes for the 5-tile working set (2 T slots, 2 Cp slots, scratch)."""
     H = _envelope.aligned_halo(k)
-    vmem_need = 5 * (bx + 2 * k) * (by + 2 * H) * n2 * itemsize
-    if vmem_need > _VMEM_BUDGET_BYTES:
-        return (
-            f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of VMEM "
-            f"(5 haloed tiles spanning z; budget {_VMEM_BUDGET_BYTES >> 20} MiB, "
-            "v5e-tuned — see _VMEM_BUDGET_BYTES); shrink the tile or k"
-        )
-    if n0 % bx != 0 or n1 % by != 0:
-        return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"
-    if by % 8 != 0 or n1 % 8 != 0:
-        return "by and the y-size must be multiples of 8 (DMA alignment)"
-    if bx + 2 * k > n0 or by + 2 * H > n1:
-        return f"haloed tile ({bx + 2 * k},{by + 2 * H}) exceeds volume; lower k"
-    # (by | n1 and by + 2H <= n1 with H >= 8 already force >= 2 y-tiles.)
-    return None
+    return 5 * (bx + 2 * k) * (by + 2 * H) * n2 * itemsize
+
+
+# (by | n1 and by + 2H <= n1 with H >= 8 already force >= 2 y-tiles.)
+_tile_error = _envelope.make_tile_error(
+    _tile_bytes, _VMEM_BUDGET_BYTES,
+    "5 haloed tiles spanning z, v5e-tuned — see _VMEM_BUDGET_BYTES",
+)
 
 
 def default_tile(shape, k: int, itemsize: int = 4):
@@ -140,7 +132,7 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    H = 8 * math.ceil(k / 8)
+    H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     ncx, ncy = n0 // bx, n1 // by
     dt_ = jnp.dtype(dtype)

@@ -22,7 +22,10 @@ Checks:
     cannot compile for a multi-chip topology),
  7. the staggered fused leapfrog kernel (even-extent padded layout) vs the
     XLA acoustic path — compiled, the config the round-2 infeasibility note
-    said could not run (reversed in round 3, see docs/performance.md).
+    said could not run (reversed in round 3, see docs/performance.md),
+ 8. the fused PT-iteration kernel vs the per-iteration XLA porous path —
+    compiled, scale-relative tolerance (flux magnitudes scale as
+    |grad Pf|/dx, so absolute ULP size scales with them).
 """
 
 import os
@@ -266,6 +269,31 @@ def check_staggered_fused():
     )
 
 
+def check_pt_fused():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import porous_convection3d as pc
+
+    state, params = pc.setup(64, 128, 256, npt=4, quiet=True, dtype=jnp.float32)
+    xla = pc.make_multi_step(params, 2, donate=False)
+    fused = pc.make_multi_step(params, 2, donate=False, fused_k=2)
+    ref = [np.asarray(A) for A in xla(*state)]
+    sync(state[0])
+    got = fused(*state)
+    sync(got[0])
+    got = [np.asarray(A) for A in got]
+    worst = 0.0
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), got, ref):
+        scale = max(float(np.abs(r).max()), 1.0)
+        rel = float(np.abs(g - r).max()) / scale
+        worst = max(worst, rel)
+        assert rel < 1e-5, (name, rel)
+    igg.finalize_global_grid()
+    print(f"8. fused PT-iteration kernel vs XLA (compiled): OK, worst rel={worst:.2e}")
+
+
 if __name__ == "__main__":
     import jax
 
@@ -277,4 +305,5 @@ if __name__ == "__main__":
     check_example()
     check_overlap_schedule()
     check_staggered_fused()
+    check_pt_fused()
     print("ALL TPU CHECKS PASSED")

@@ -166,3 +166,97 @@ def test_convection_starts_and_is_bounded():
     assert qDz.max() > 1e-8
     # temperature stays within the physical contrast (+ perturbation margin)
     assert T.max() <= 0.65 and T.min() >= -0.65
+
+
+def test_fused_single_device_matches_xla():
+    """fused_k on a no-halo-activity grid: the fluxes stay in the kernel's
+    padded layout across the whole PT loop; results must match the plain
+    multi-step path to few (scale-relative) f32 ULPs."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 2
+    kw = dict(devices=jax.devices()[:1], npt=4, quiet=True)
+    state, params = pc.setup(16, 32, 128, **kw)
+    step = pc.make_multi_step(params, nt, donate=False)
+    ref = [np.asarray(A) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = pc.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = pc.make_multi_step(
+            params, nt, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+        got = [np.asarray(A) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_fused_deep_halo_matches_xla_multiblock():
+    """k fused PT iterations + one width-k all-field slab exchange vs the
+    per-iteration comm-lean path (interpret-mode kernel; 2 devices — the
+    interpret-mode Pallas + shard_map deadlock constraint)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 2
+    kw = dict(
+        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, overlapx=4,
+        npt=4, quiet=True,
+    )
+    state, params = pc.setup(16, 32, 128, **kw)
+    step = pc.make_multi_step(params, nt, donate=False)
+    ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = pc.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = pc.make_multi_step(
+            params, nt, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+        got = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_fused_fallback_warns_and_matches_cadence():
+    """A local block the kernel envelope rejects must warn once and run the
+    XLA path at the same slab cadence — bit-identical to exchange_every=w."""
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, npt=4, quiet=True)
+    state, params = pc.setup(10, 10, 10, **kw)
+    step = pc.make_multi_step(params, 2, donate=False, exchange_every=2)
+    ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = pc.setup(10, 10, 10, **kw)
+    with pytest.warns(RuntimeWarning, match="falling back to the XLA path"):
+        stepf = pc.make_multi_step(params, 2, donate=False, fused_k=2)
+        got = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), got, ref):
+        np.testing.assert_array_equal(g, r, err_msg=name)
+
+
+def test_fused_validation():
+    state, params = pc.setup(
+        16, 32, 128, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        npt=4, quiet=True,
+    )
+    with pytest.raises(ValueError, match="deep halo"):
+        pc.make_multi_step(params, 2, fused_k=2)
+    igg.finalize_global_grid()
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    state, params = pc.setup(10, 10, 10, npt=5, **kw)
+    with pytest.raises(ValueError, match="multiple of fused_k"):
+        pc.make_multi_step(params, 2, fused_k=2)
+    igg.finalize_global_grid()
+    state, params = pc.setup(10, 10, 10, npt=4, **kw)
+    with pytest.raises(ValueError, match="conflicts"):
+        pc.make_multi_step(params, 2, fused_k=2, exchange_every=4)
+    with pytest.raises(ValueError, match="pass both bx and by"):
+        pc.make_multi_step(params, 2, fused_k=2, fused_tile=(8, None))
+    igg.finalize_global_grid()
+    state, params = pc.setup(10, 10, 10, npt=4, hide_comm=True, **kw)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        pc.make_multi_step(params, 2, fused_k=2)
+    igg.finalize_global_grid()
