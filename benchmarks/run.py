@@ -387,8 +387,17 @@ def main():
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly
         porous_chunk = a.chunk if a.what == "porous" else 4
+        npt = a.npt
+        if a.fused_k and npt % a.fused_k != 0:
+            # The PT cadence requires npt % w == 0 (make_multi_step raises);
+            # round npt up so `all --fused-k K` keeps running the porous and
+            # weak-scaling configs.
+            npt = ((npt + a.fused_k - 1) // a.fused_k) * a.fused_k
+            print(json.dumps({"note": f"porous npt {a.npt} -> {npt} "
+                              f"(must be a multiple of fused_k={a.fused_k})"}),
+                  flush=True)
         bench_porous(n=a.n or (256 if a.fused_k else 128), chunk=porous_chunk,
-                     reps=a.reps, npt=a.npt, dtype=a.dtype, fused_k=a.fused_k,
+                     reps=a.reps, npt=npt, dtype=a.dtype, fused_k=a.fused_k,
                      exchange_every=a.exchange_every, overlap=a.overlap)
     if a.what in ("weak", "all"):
         bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,

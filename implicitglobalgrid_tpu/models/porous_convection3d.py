@@ -360,6 +360,32 @@ def make_multi_step(
     p_update = _pressure_update(params)
     npt = params.npt
 
+    def cadence_block_step(w):
+        """One time step at the w-iterations-per-slab-exchange cadence — the
+        ONE definition behind both ``exchange_every=w`` and the ``fused_k``
+        branch's XLA fallback, so the fallback's bit-identical-to-cadence
+        contract can never drift.  The exchanges are no-ops on dimensions
+        without halo activity, so the same body serves 1-device grids."""
+
+        def block_step(T, Pf, qDx, qDy, qDz):
+            # One fori_loop over groups; the small w-iteration body is
+            # unrolled (a nested fori_loop is the measured-slow shape).
+            def group(i, s):
+                Pf, qDx, qDy, qDz = s
+                for _ in range(w):
+                    qDx, qDy, qDz = flux_update(T, Pf, qDx, qDy, qDz)
+                    Pf = p_update(Pf, qDx, qDy, qDz)
+                return update_halo(Pf, qDx, qDy, qDz, width=w)
+
+            Pf, qDx, qDy, qDz = lax.fori_loop(
+                0, npt // w, group, (Pf, qDx, qDy, qDz)
+            )
+            T = t_update(T, qDx, qDy, qDz)
+            T = update_halo(T)
+            return T, Pf, qDx, qDy, qDz
+
+        return block_step
+
     if fused_k:
         import jax
 
@@ -405,13 +431,6 @@ def make_multi_step(
                 bx=bx, by=by,
             )
 
-        def xla_group(T, s):
-            Pf, qDx, qDy, qDz = s
-            for _ in range(w):
-                qDx, qDy, qDz = flux_update(T, Pf, qDx, qDy, qDz)
-                Pf = p_update(Pf, qDx, qDy, qDz)
-            return Pf, qDx, qDy, qDz
-
         if not active:
 
             def fused_block_step(T, Pf, qDx, qDy, qDz):
@@ -448,20 +467,7 @@ def make_multi_step(
                 T = update_halo(T)
                 return T, Pf, qDx, qDy, qDz
 
-        def xla_block_step(T, Pf, qDx, qDy, qDz):
-            def group(i, s):
-                s = xla_group(T, s)
-                if active:
-                    return update_halo(*s, width=w)
-                return s
-
-            Pf, qDx, qDy, qDz = lax.fori_loop(
-                0, npt // w, group, (Pf, qDx, qDy, qDz)
-            )
-            T = t_update(T, qDx, qDy, qDz)
-            if active:
-                T = update_halo(T)
-            return T, Pf, qDx, qDy, qDz
+        xla_block_step = cadence_block_step(w)
 
         def block_step(T, Pf, qDx, qDy, qDz):
             # Shapes are only known at trace time, so the kernel-vs-fallback
@@ -489,24 +495,7 @@ def make_multi_step(
                 f"npt={npt} must be a multiple of exchange_every={exchange_every}"
             )
         require_deep_halo(exchange_every)
-        w = exchange_every
-
-        def block_step(T, Pf, qDx, qDy, qDz):
-            # One fori_loop over groups; the small w-iteration body is
-            # unrolled (a nested fori_loop is the measured-slow shape).
-            def group(i, s):
-                Pf, qDx, qDy, qDz = s
-                for _ in range(w):
-                    qDx, qDy, qDz = flux_update(T, Pf, qDx, qDy, qDz)
-                    Pf = p_update(Pf, qDx, qDy, qDz)
-                return update_halo(Pf, qDx, qDy, qDz, width=w)
-
-            Pf, qDx, qDy, qDz = lax.fori_loop(
-                0, npt // w, group, (Pf, qDx, qDy, qDz)
-            )
-            T = t_update(T, qDx, qDy, qDz)
-            T = update_halo(T)
-            return T, Pf, qDx, qDy, qDz
+        block_step = cadence_block_step(exchange_every)
 
     else:
         block_step = _build_block_step(params)

@@ -147,6 +147,24 @@ def test_t_input_buffer_unmodified():
     np.testing.assert_array_equal(np.asarray(state[0]), t_before)
 
 
+def test_bfloat16_structure():
+    # Structural correctness at bf16 accuracy + bit-exact frozen flux faces
+    # (same coverage bar as the diffusion and leapfrog kernels).
+    state, params = _setup((16, 32, 128), seed=11, dtype=jnp.bfloat16)
+    ref = _xla_iters(state, params, 2)
+    got = _fused_interpret(state, params, 2, bx=8, by=16)
+    for name, g, r in zip(("Pf", "qDx", "qDy", "qDz"), got, ref):
+        g = np.asarray(g.astype(jnp.float32))
+        r = np.asarray(r.astype(jnp.float32))
+        scale = max(float(np.abs(r).max()), 1.0)
+        assert float(np.abs(g - r).max()) / scale < 0.05, name
+    q0, qk = np.asarray(state[2].astype(jnp.float32)), np.asarray(
+        got[1].astype(jnp.float32)
+    )
+    assert np.array_equal(qk[0], q0[0])
+    assert np.array_equal(qk[-1], q0[-1])
+
+
 def test_envelope_validation():
     state, params = _setup((16, 32, 128))
     T, Pf, qDx, qDy, qDz = state
