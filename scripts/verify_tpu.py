@@ -25,7 +25,11 @@ Checks:
     said could not run (reversed in round 3, see docs/performance.md),
  8. the fused PT-iteration kernel vs the per-iteration XLA porous path —
     compiled, scale-relative tolerance (flux magnitudes scale as
-    |grad Pf|/dx, so absolute ULP size scales with them).
+    |grad Pf|/dx, so absolute ULP size scales with them),
+ 9. the multi-chip staggered fused program AOT-compiled for an 8-chip TPU
+    topology: acoustic fused_k chunk (Mosaic kernel + width-k all-field
+    slab exchange) lowered over a 2x2x2 mesh — the Pallas custom call and
+    the collective-permute exchanges coexist in one compiled program.
 """
 
 import os
@@ -269,6 +273,115 @@ def check_staggered_fused():
     )
 
 
+def _aot_staggered_fused_hlo():
+    """AOT-compile the acoustic fused_k chunk for an 8-chip topology.
+
+    Same synthetic-GlobalGrid technique as `_aot_hide_comm_hlo`; the mesh is
+    2x2x2 with deep halos in every dimension, local blocks (16, 32, 128)
+    with the (8, 16) tile, so the kernel envelope accepts the block and the
+    program contains BOTH the Mosaic kernel custom-call and the width-2
+    slab exchanges."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    kind = jax.devices()[0].device_kind
+    topo = None
+    for name in (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"):
+        try:
+            topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
+            break
+        except Exception:
+            continue
+    if topo is None:
+        raise RuntimeError("no AOT topology description available")
+    devs = np.asarray(topo.devices)[:8].reshape(2, 2, 2)
+    mesh = Mesh(devs, ("x", "y", "z"))
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import acoustic3d
+    from implicitglobalgrid_tpu.parallel import grid as _grid
+
+    igg.init_global_grid(
+        16, 32, 128, overlapx=4, overlapy=4, overlapz=4, quiet=True,
+        devices=list(jax.devices())[:1],
+    )
+    gg0 = igg.get_global_grid()
+    gg = dataclasses.replace(gg0, mesh=mesh, dims=(2, 2, 2), nprocs=8, coords=(0, 0, 0))
+    _grid.set_global_grid(gg)
+    try:
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from implicitglobalgrid_tpu.ops.pallas_leapfrog import (
+            fused_leapfrog_steps,
+            pad_faces,
+            unpad_faces,
+        )
+
+        # The fused chunk body of acoustic3d.make_multi_step's deep-halo
+        # branch, shard_mapped by hand (the `stencil` wrapper builds from
+        # concrete args, which AOT avals cannot provide).
+        c = 1e-3 / 0.1
+
+        def block_step(Pf, Vx, Vy, Vz):
+            def group(i, s):
+                Pf, Vx, Vy, Vz = s
+                Vxp, Vyp, Vzp = pad_faces(Vx, Vy, Vz)
+                Pf, Vxp, Vyp, Vzp = fused_leapfrog_steps(
+                    Pf, Vxp, Vyp, Vzp, 2, c, c, c, 1e-3, 10.0, 10.0, 10.0,
+                    bx=8, by=16,
+                )
+                Vx, Vy, Vz = unpad_faces(Vxp, Vyp, Vzp)
+                return igg.update_halo(Pf, Vx, Vy, Vz, width=2)
+
+            return lax.fori_loop(0, 2, group, (Pf, Vx, Vy, Vz))
+
+        mapped = jax.jit(
+            jax.shard_map(
+                block_step, mesh=mesh,
+                in_specs=(P("x", "y", "z"),) * 4,
+                out_specs=(P("x", "y", "z"),) * 4,
+                check_vma=False,
+            )
+        )
+        spec = NamedSharding(mesh, P("x", "y", "z"))
+        avals = tuple(
+            jax.ShapeDtypeStruct(s, np.float32, sharding=spec)
+            for s in ((32, 64, 256), (34, 64, 256), (32, 66, 256), (32, 64, 258))
+        )
+        return mapped.lower(*avals).compile().as_text()
+    finally:
+        _grid.set_global_grid(gg0)
+        igg.finalize_global_grid()
+
+
+def check_multichip_fused_aot():
+    """Pin the multi-chip staggered fused path on the real backend's AOT
+    compiler: kernel custom-call + collective-permute exchanges in one
+    program.  Only the AOT compile itself may skip (same rule as check 6)."""
+    try:
+        txt = _aot_staggered_fused_hlo()
+    except Exception as e:  # noqa: BLE001 — report and point at the CPU pin
+        print(
+            f"9. multi-chip staggered fused AOT: SKIPPED ({type(e).__name__}: "
+            f"{e}) — the path is pinned by tests/test_models_acoustic.py::"
+            "test_fused_deep_halo_matches_xla_multiblock on the CPU mesh"
+        )
+        return
+    assert "tpu_custom_call" in txt, "no Mosaic kernel custom-call in the AOT program"
+    n_cp = txt.count("collective-permute-start(") + txt.count("collective-permute(")
+    assert n_cp >= 6, f"expected >= 6 slab exchanges in the AOT program, got {n_cp}"
+    print(
+        f"9. multi-chip staggered fused AOT (2x2x2): OK — Mosaic kernel + "
+        f"{n_cp} collective-permute exchanges in one program"
+    )
+
+
 def check_pt_fused():
     import jax.numpy as jnp
     import numpy as np
@@ -306,4 +419,5 @@ if __name__ == "__main__":
     check_overlap_schedule()
     check_staggered_fused()
     check_pt_fused()
+    check_multichip_fused_aot()
     print("ALL TPU CHECKS PASSED")
