@@ -103,3 +103,26 @@ def test_bench_entrypoint_contract(monkeypatch, capsys):
     assert "error" in rec["extras"]["diffusion_512_pallas_fused4"]
     assert rec["extras"]["acoustic"]["teff"] == 400.0
     assert rec["extras"]["porous_pt"]["teff"] == 350.0
+
+
+def test_fused_provenance_labels():
+    """A fused_k request whose shape the envelope rejects must be labeled as
+    the fallback in the emitted metric name and path record (an XLA number
+    must never be recorded under a fused-kernel label)."""
+    from benchmarks.run import _fused_provenance
+    from implicitglobalgrid_tpu.ops.pallas_pt import fused_support_error as pt_err
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        fused_support_error as diff_err,
+    )
+
+    assert _fused_provenance(None, diff_err, (256, 256, 256), 4, None) == ("", None)
+    assert _fused_provenance(4, diff_err, (256, 256, 256), 4, None) == (
+        "_fused4", "pallas-fused"
+    )
+    # 192 minor dim: rejected by the lane-alignment envelope -> fallback label.
+    assert _fused_provenance(4, diff_err, (192, 192, 192), 4, None) == (
+        "_fused4fb", "xla-fallback"
+    )
+    assert _fused_provenance(2, pt_err, (160, 160, 160), 4, None) == (
+        "_fused2fb", "xla-fallback"
+    )
