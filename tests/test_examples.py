@@ -87,3 +87,24 @@ def test_tpu_fused_runs():
     gshape = T.shape
     assert np.isfinite(T).all() and T.max() > 0
     assert not igg.grid_is_initialized()
+
+
+def test_acoustic_fused_runs():
+    # The staggered fused example on the virtual mesh (interpret-mode
+    # kernel; per-block (16, 32, 128) fits the (8, 16) tile envelope at
+    # k=2 — the nx=256 k=6 production default is a hardware config).
+    from jax.experimental.pallas import tpu as pltpu
+
+    import jax
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+
+    mod = _load("acoustic3d_tpu_fused")
+    with pltpu.force_tpu_interpret_mode():
+        P = mod.acoustic3d_fused(
+            nx=16, ny=32, nz=128, nt=4, k=2, fused_tile=(8, 16), quiet=True,
+            devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        )
+    assert np.isfinite(np.asarray(P)).all()
+    assert not igg.grid_is_initialized()
