@@ -300,17 +300,20 @@ def make_multi_step(
             )
 
         def fused_block_step(P, Vx, Vy, Vz):
+            from ..ops.halo import update_halo_padded_faces
+
             def group(i, s):
-                P, Vx, Vy, Vz = s
-                Vxp, Vyp, Vzp = pad_faces(Vx, Vy, Vz)
-                P, Vxp, Vyp, Vzp = kernel_steps(P, Vxp, Vyp, Vzp)
-                Vx, Vy, Vz = unpad_faces(Vxp, Vyp, Vzp)
+                s = kernel_steps(*s)
                 # One all-field slab exchange licenses the next fused_k
                 # steps (see the exchange_every docstring for why P's slab
-                # must ride along).
-                return update_halo(P, Vx, Vy, Vz, width=fused_k)
+                # must ride along) — directly on the padded layout, so the
+                # chunk pays ONE pad/unpad instead of one per group.
+                return update_halo_padded_faces(*s, width=fused_k)
 
-            return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
+            P, Vxp, Vyp, Vzp = lax.fori_loop(
+                0, nsteps // fused_k, group, (P, *pad_faces(Vx, Vy, Vz))
+            )
+            return (P, *unpad_faces(Vxp, Vyp, Vzp))
 
         def xla_cadence_step(P, Vx, Vy, Vz):
             def group(i, s):

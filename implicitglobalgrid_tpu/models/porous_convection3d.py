@@ -451,18 +451,20 @@ def make_multi_step(
         else:
 
             def fused_block_step(T, Pf, qDx, qDy, qDz):
-                def group(i, s):
-                    Pf, qDx, qDy, qDz = s
-                    qxp, qyp, qzp = pad_faces(qDx, qDy, qDz)
-                    Pf, qxp, qyp, qzp = kernel_iters(T, Pf, qxp, qyp, qzp)
-                    qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
-                    # All four PT fields slab-exchange (the fluxes' rind
-                    # relaxation history is stale — see exchange_every).
-                    return update_halo(Pf, qDx, qDy, qDz, width=w)
+                from ..ops.halo import update_halo_padded_faces
 
-                Pf, qDx, qDy, qDz = lax.fori_loop(
-                    0, npt // w, group, (Pf, qDx, qDy, qDz)
+                def group(i, s):
+                    Pf, qxp, qyp, qzp = kernel_iters(T, *s)
+                    # All four PT fields slab-exchange (the fluxes' rind
+                    # relaxation history is stale — see exchange_every) —
+                    # directly on the padded layout: one pad/unpad per
+                    # whole PT loop instead of one per group.
+                    return update_halo_padded_faces(Pf, qxp, qyp, qzp, width=w)
+
+                Pf, qxp, qyp, qzp = lax.fori_loop(
+                    0, npt // w, group, (Pf, *pad_faces(qDx, qDy, qDz))
                 )
+                qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
                 T = t_update(T, qDx, qDy, qDz)
                 T = update_halo(T)
                 return T, Pf, qDx, qDy, qDz

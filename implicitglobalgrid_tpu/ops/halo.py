@@ -206,7 +206,7 @@ def _get_plane(A, index: int, dim: int, width: int = 1):
     return lax.slice_in_dim(A, index, index + width, axis=dim)
 
 
-def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
+def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
     """Exchange the two halo slabs (``width`` planes each) of block ``A``
     along dimension ``d``.
 
@@ -218,11 +218,19 @@ def _exchange_dim(A, d: int, gg, width: int = 1) -> "jax.Array":
     ``w`` fused steps.  Valid iff ``ol >= 2*width`` (the sent planes must lie
     at distance >= width from my own edge, where a width-deep stencil sweep
     still has exact values).
+
+    ``logical``: the field's REAL local shape when ``A`` carries it in a
+    larger padded layout (`ops.pallas_leapfrog.pad_faces`) — slab indices
+    and the shape-aware ``ol`` are computed from it, and since every real
+    plane index is within the padded array the slicing needs no change.
+    The pad tail is junk by the layout's contract, so exchanging junk
+    planes along *other* dimensions (full-extent slabs include the tail)
+    is harmless.
     """
     import jax.numpy as jnp
     from jax import lax
 
-    shp = tuple(A.shape)  # local block shape (tracer context)
+    shp = logical if logical is not None else tuple(A.shape)  # local block shape
     if d >= len(shp):
         # A dimension beyond the field's rank can only ever be exchanged with a
         # self/absent neighbor (grid validation forces dims[d]==1, period 0).
@@ -313,6 +321,44 @@ def _update_halo_local(fields: tuple, gg, width: int = 1) -> tuple:
     for d in range(NDIMS):
         for i in range(len(out)):
             out[i] = _exchange_dim(out[i], d, gg, width)
+    return tuple(out)
+
+
+def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1):
+    """Slab-exchange a cell field + three `pad_faces`-layout staggered fields.
+
+    The models' fused deep-halo cadences keep the staggered fields in the
+    kernel's padded layout across a whole chunk; exchanging them directly
+    (with slab indices computed from the REAL ``n+1`` shapes via the
+    ``logical`` override of `_exchange_dim`) removes the two HBM passes per
+    field per group an unpad/re-pad pair would cost.  Owned results are
+    bitwise identical to unpad→`update_halo`→pad: the same real planes
+    move; only the junk tail differs (it receives exchanged junk instead of
+    zeros, and the layout's contract already forbids reading it).
+
+    Tracer-context only (inside `stencil`/shard_map — where the fused block
+    steps live); the public `update_halo` remains the global-array entry.
+    """
+    from ..parallel import grid as _g
+    from .pallas_leapfrog import padded_face_shapes
+
+    gg = _g.global_grid()
+    n0, n1, n2 = C.shape
+    if (Axp.shape, Ayp.shape, Azp.shape) != padded_face_shapes(C.shape):
+        raise ValueError(
+            f"fields must be in pad_faces layout for cell shape {tuple(C.shape)}: "
+            f"got {Axp.shape}, {Ayp.shape}, {Azp.shape}"
+        )
+    logicals = (
+        None,
+        (n0 + 1, n1, n2),
+        (n0, n1 + 1, n2),
+        (n0, n1, n2 + 1),
+    )
+    out = [C, Axp, Ayp, Azp]
+    for d in range(NDIMS):
+        for i in range(len(out)):
+            out[i] = _exchange_dim(out[i], d, gg, width, logical=logicals[i])
     return tuple(out)
 
 

@@ -125,6 +125,26 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     )
 
 
+#: Padded-axis extents of the `pad_faces` layout, relative to the CELL size:
+#: a padded face array spans ``cell + PADS[axis]`` along its own staggered
+#: axis (``n+1`` real faces + junk planes).  x/y need sublane alignment (8);
+#: z is the minor axis, where Mosaic requires lane-tile alignment (128).
+#: The single source of truth for every pad_faces shape check
+#: (`fused_leapfrog_steps`, `ops.pallas_pt.fused_pt_iterations`,
+#: `ops.halo.update_halo_padded_faces`).
+PADS = (8, 8, 128)
+
+
+def padded_face_shapes(cell_shape):
+    """The three `pad_faces` array shapes for a given cell shape."""
+    n0, n1, n2 = cell_shape
+    return (
+        (n0 + PADS[0], n1, n2),
+        (n0, n1 + PADS[1], n2),
+        (n0, n1, n2 + PADS[2]),
+    )
+
+
 def pad_faces(Vx, Vy, Vz):
     """Face fields ``(n+1 staggered)`` -> even-extent padded kernel layout.
 
@@ -138,15 +158,19 @@ def pad_faces(Vx, Vy, Vz):
     import jax.numpy as jnp
 
     return (
-        jnp.pad(Vx, ((0, 7), (0, 0), (0, 0))),
-        jnp.pad(Vy, ((0, 0), (0, 7), (0, 0))),
-        jnp.pad(Vz, ((0, 0), (0, 0), (0, 127))),
+        jnp.pad(Vx, ((0, PADS[0] - 1), (0, 0), (0, 0))),
+        jnp.pad(Vy, ((0, 0), (0, PADS[1] - 1), (0, 0))),
+        jnp.pad(Vz, ((0, 0), (0, 0), (0, PADS[2] - 1))),
     )
 
 
 def unpad_faces(Vxp, Vyp, Vzp):
     """Inverse of `pad_faces`: slice the ``n+1`` real faces back out."""
-    return (Vxp[:-7], Vyp[:, :-7], Vzp[:, :, :-127])
+    return (
+        Vxp[: 1 - PADS[0]],
+        Vyp[:, : 1 - PADS[1]],
+        Vzp[:, :, : 1 - PADS[2]],
+    )
 
 
 def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
@@ -162,9 +186,7 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
     two paths differ only by FMA contraction.
     """
     n0, n1, n2 = P.shape
-    if not (Vxp.shape == (n0 + 8, n1, n2)
-            and Vyp.shape == (n0, n1 + 8, n2)
-            and Vzp.shape == (n0, n1, n2 + 128)):
+    if (Vxp.shape, Vyp.shape, Vzp.shape) != padded_face_shapes(P.shape):
         raise ValueError(
             f"V fields must be in pad_faces layout for P{P.shape}: got "
             f"{Vxp.shape}, {Vyp.shape}, {Vzp.shape}"

@@ -39,7 +39,11 @@ from __future__ import annotations
 import functools
 
 from . import _fused_envelope as _envelope
-from .pallas_leapfrog import pad_faces, unpad_faces  # noqa: F401  (re-export)
+from .pallas_leapfrog import (  # noqa: F401  (re-export)
+    pad_faces,
+    padded_face_shapes,
+    unpad_faces,
+)
 
 _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
 
@@ -101,9 +105,7 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
     n0, n1, n2 = Pf.shape
     if T.shape != Pf.shape:
         raise ValueError(f"T{T.shape} and Pf{Pf.shape} must share the cell shape")
-    if not (qxp.shape == (n0 + 8, n1, n2)
-            and qyp.shape == (n0, n1 + 8, n2)
-            and qzp.shape == (n0, n1, n2 + 128)):
+    if (qxp.shape, qyp.shape, qzp.shape) != padded_face_shapes(Pf.shape):
         raise ValueError(
             f"flux fields must be in pad_faces layout for Pf{Pf.shape}: got "
             f"{qxp.shape}, {qyp.shape}, {qzp.shape}"

@@ -328,18 +328,19 @@ def _aot_staggered_fused_hlo():
         # concrete args, which AOT avals cannot provide).
         c = 1e-3 / 0.1
 
+        from implicitglobalgrid_tpu.ops.halo import update_halo_padded_faces
+
         def block_step(Pf, Vx, Vy, Vz):
             def group(i, s):
-                Pf, Vx, Vy, Vz = s
-                Vxp, Vyp, Vzp = pad_faces(Vx, Vy, Vz)
-                Pf, Vxp, Vyp, Vzp = fused_leapfrog_steps(
-                    Pf, Vxp, Vyp, Vzp, 2, c, c, c, 1e-3, 10.0, 10.0, 10.0,
-                    bx=8, by=16,
+                s = fused_leapfrog_steps(
+                    *s, 2, c, c, c, 1e-3, 10.0, 10.0, 10.0, bx=8, by=16
                 )
-                Vx, Vy, Vz = unpad_faces(Vxp, Vyp, Vzp)
-                return igg.update_halo(Pf, Vx, Vy, Vz, width=2)
+                return update_halo_padded_faces(*s, width=2)
 
-            return lax.fori_loop(0, 2, group, (Pf, Vx, Vy, Vz))
+            Pf, Vxp, Vyp, Vzp = lax.fori_loop(
+                0, 2, group, (Pf, *pad_faces(Vx, Vy, Vz))
+            )
+            return (Pf, *unpad_faces(Vxp, Vyp, Vzp))
 
         mapped = jax.jit(
             jax.shard_map(

@@ -461,3 +461,47 @@ def test_random_config_sweep(seed):
         lshape,
     ]
     check(lshape, stag, width=width, **periods, **overlaps)
+
+
+@pytest.mark.parametrize(
+    "initkw,width",
+    [
+        (dict(dimx=2, dimy=1, dimz=1, devices_n=2), 1),
+        (dict(dimx=1, dimy=2, dimz=1, devices_n=2), 1),
+        (dict(dimx=1, dimy=1, dimz=2, devices_n=2), 1),
+        (dict(overlapx=4, overlapy=4, overlapz=4), 2),
+        (dict(periodx=1, periody=1, periodz=1, overlapx=4, overlapy=4,
+              overlapz=4), 2),
+    ],
+)
+def test_padded_faces_exchange_matches_unpadded(initkw, width):
+    """`update_halo_padded_faces` contract: owned results bitwise identical
+    to unpad -> `update_halo` -> pad, across per-dimension splits, widths,
+    and periodic wrap (the fused models' padded-layout exchange)."""
+    from implicitglobalgrid_tpu.ops.halo import update_halo_padded_faces
+    from implicitglobalgrid_tpu.ops.pallas_leapfrog import pad_faces, unpad_faces
+
+    initkw = dict(initkw)
+    n_dev = initkw.pop("devices_n", None)
+    if n_dev:
+        initkw["devices"] = jax.devices()[:n_dev]
+    lshape = (8, 8, 8)
+    igg.init_global_grid(*lshape, quiet=True, **initkw)
+    gg = igg.get_global_grid()
+    cell = unique_field(lshape, gg)
+    faces = [
+        unique_field(tuple(s + (1 if d == ax else 0) for d, s in enumerate(lshape)), gg)
+        for ax in range(3)
+    ]
+    ref = igg.update_halo(*[put(f) for f in [cell, *faces]], width=width)
+    ref = [np.asarray(A) for A in ref]
+
+    padded_exchange = igg.stencil(
+        lambda C, Ax, Ay, Az: (
+            lambda out: (out[0], *unpad_faces(*out[1:]))
+        )(update_halo_padded_faces(C, *pad_faces(Ax, Ay, Az), width=width))
+    )
+    got = padded_exchange(*[put(f) for f in [cell, *faces]])
+    for name, g, r in zip(("cell", "fx", "fy", "fz"), got, ref):
+        np.testing.assert_array_equal(np.asarray(g), r, err_msg=name)
+    igg.finalize_global_grid()
