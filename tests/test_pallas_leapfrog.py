@@ -177,3 +177,27 @@ def test_diffusion_envelope_minor_alignment():
 
     assert "multiple of 128" in diff_err((64, 128, 192), 2)
     assert diff_err((64, 128, 256), 2) is None
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_envelope_config_matches_xla(seed):
+    """Property sweep: a random envelope-valid (shape, k, tile) config must
+    match k XLA leapfrog steps (same oracle as the pinned cases)."""
+    rng = np.random.default_rng(100 + seed)
+    k = int(rng.choice([2, 4, 6]))
+    bx = int(rng.choice([8, 16]))
+    by = int(rng.choice([8, 16]))
+    H = 8 * ((k + 7) // 8)
+    n0 = bx * int(rng.integers((2 * k) // bx + 2, 5))
+    n1 = by * max(int(rng.integers(2, 5)), (by + 2 * H) // by + 1)
+    shape = (n0, n1, 128)
+    err = fused_support_error(shape, k, 4, bx, by)
+    if err is not None:
+        pytest.skip(f"random config rejected by envelope: {err}")
+    state, params = _setup(shape, seed=seed, spacing=(0.11, 0.13, 0.17), K=1.4, rho=0.7)
+    ref = _xla_steps(state, params, k)
+    got = _fused_interpret(state, params, k, bx=bx, by=by)
+    for name, g, r in zip(("P", "Vx", "Vy", "Vz"), got, ref):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=3e-5, atol=3e-5, err_msg=name
+        )
