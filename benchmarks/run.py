@@ -313,7 +313,8 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     )
 
 
-def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False):
+def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False,
+                       model="diffusion", npt=10):
     """Weak scaling: same local n^3 per device on growing sub-meshes.
 
     Parallel efficiency = t(1 device) / t(N devices); ~1.0 means the halo
@@ -322,6 +323,12 @@ def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False
     path as the multi-device runs — otherwise the 1-device fast path (see
     docs/performance.md) would make the ratio conflate SPMD dispatch
     overhead with communication cost.
+
+    ``model="porous"`` runs the HydroMech analogue instead — BASELINE
+    config 4 is *porous* weak scaling (npt PT iterations per step, the
+    communication-heaviest pattern); the porous model has no force_spmd
+    lever, so its 1-device point keeps the plain-jit fast path and the
+    reported efficiency is conservative on 1-core virtual meshes.
     """
     import jax
 
@@ -335,17 +342,23 @@ def bench_weak_scaling(n=128, chunk=25, reps=4, dtype="float32", hide_comm=False
         counts.append(len(devs))
     results = {}
     for c in counts:
-        rec = bench_diffusion(
-            n=n, chunk=chunk, reps=reps, dtype=dtype, hide_comm=hide_comm,
-            devices=devs[:c], force_spmd=True,
-        )
+        if model == "porous":
+            rec = bench_porous(
+                n=n, chunk=max(chunk // npt, 1), reps=reps, npt=npt,
+                dtype=dtype, devices=devs[:c],
+            )
+        else:
+            rec = bench_diffusion(
+                n=n, chunk=chunk, reps=reps, dtype=dtype, hide_comm=hide_comm,
+                devices=devs[:c], force_spmd=True,
+            )
         results[c] = rec["t_it_ms"]
     base = results[1]
     effs = {c: round(base / t, 4) for c, t in results.items()}
     print(
         json.dumps(
             {
-                "metric": f"weak_scaling_diffusion3d_{n}_{dtype}"
+                "metric": f"weak_scaling_{model}3d_{n}_{dtype}"
                 + ("_overlap" if hide_comm else ""),
                 "value": effs[counts[-1]],
                 "unit": "parallel_efficiency",
@@ -374,6 +387,10 @@ def main():
     p.add_argument("--overlap", type=int, default=None,
                    help="grid overlap in every dimension (deep halos for "
                         "--fused-k/--exchange-every on communicating grids)")
+    p.add_argument("--weak-model", default="diffusion",
+                   choices=["diffusion", "porous"],
+                   help="model for the weak-scaling config (BASELINE config 4 "
+                        "is porous weak scaling)")
     a = p.parse_args()
     kw = dict(chunk=a.chunk, reps=a.reps, dtype=a.dtype)
     if a.what in ("diffusion", "all"):
@@ -401,7 +418,8 @@ def main():
                      exchange_every=a.exchange_every, overlap=a.overlap)
     if a.what in ("weak", "all"):
         bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,
-                           dtype=a.dtype, hide_comm=a.hide_comm)
+                           dtype=a.dtype, hide_comm=a.hide_comm,
+                           model=a.weak_model, npt=a.npt)
 
 
 if __name__ == "__main__":
