@@ -70,6 +70,15 @@ def support_error(shape, k, itemsize, bx, by, *, tile_error, candidates):
     single source of truth behind each kernel's ``fused_support_error``.
     """
     n0, n1, n2 = shape
+    if itemsize > 4:
+        # TPU hardware has no 8-byte element type: XLA emulates f64 in
+        # software but Mosaic kernels cannot — without this check an
+        # x64/complex field reaches a Mosaic compile error instead of the
+        # warn-once XLA fallback.
+        return (
+            f"itemsize {itemsize} (f64/complex) is not supported by TPU "
+            "Pallas kernels; fall back to the XLA path (XLA emulates x64)"
+        )
     if k < 2 or k % 2 != 0 or k > 6:
         return (
             f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "

@@ -147,7 +147,10 @@ def test_fused_single_device_matches_xla():
     from jax.experimental.pallas import tpu as pltpu
 
     nt = 4
-    kw = dict(devices=jax.devices()[:1], quiet=True)
+    # dtype pinned: the suite runs x64, and f64 is outside the kernel
+    # envelope (TPU Pallas has no 8-byte types) — without it this test
+    # would silently exercise the XLA fallback instead of the kernel.
+    kw = dict(devices=jax.devices()[:1], quiet=True, dtype=jax.numpy.float32)
     state, params = acoustic3d.setup(16, 32, 128, **kw)
     step = acoustic3d.make_multi_step(params, nt, donate=False)
     ref = [np.asarray(A) for A in jax.block_until_ready(step(*state))]
@@ -175,7 +178,8 @@ def test_fused_deep_halo_matches_xla_multiblock():
 
     nt = 4
     kw = dict(
-        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, overlapx=4, quiet=True
+        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, overlapx=4, quiet=True,
+        dtype=jax.numpy.float32,  # pinned: f64 is outside the kernel envelope
     )
     state, params = acoustic3d.setup(16, 32, 128, **kw)
     step = acoustic3d.make_multi_step(params, nt, donate=False)
@@ -197,7 +201,10 @@ def test_fused_fallback_warns_and_matches_xla():
     """A local block the kernel envelope rejects (y-size not a multiple of 8)
     must warn once and run the XLA path at the same all-field slab cadence —
     bit-identical to the per-step path at group boundaries."""
-    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    # dtype pinned so the fallback fires for the documented y%8 shape
+    # rejection, not the x64-itemsize check (the suite runs x64).
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True,
+              dtype=jax.numpy.float32)
     state, params = acoustic3d.setup(10, 10, 10, **kw)
     step = acoustic3d.make_multi_step(params, 4, donate=False)
     ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]

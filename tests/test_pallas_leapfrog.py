@@ -201,3 +201,17 @@ def test_random_envelope_config_matches_xla(seed):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(r), rtol=3e-5, atol=3e-5, err_msg=name
         )
+
+
+def test_envelope_rejects_x64():
+    # f64/complex reaches the XLA fallback, not a Mosaic compile error
+    # (TPU Pallas has no 8-byte element type) — all three kernels share
+    # the check via ops/_fused_envelope.py.
+    from implicitglobalgrid_tpu.ops.pallas_pt import fused_support_error as pt_err
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        fused_support_error as diff_err,
+    )
+
+    for err_fn in (fused_support_error, pt_err, diff_err):
+        assert "not supported by TPU" in err_fn((64, 128, 128), 2, 8)
+        assert err_fn((64, 128, 128), 2, 4) is None
