@@ -108,3 +108,23 @@ def test_acoustic_fused_runs():
         )
     assert np.isfinite(np.asarray(P)).all()
     assert not igg.grid_is_initialized()
+
+
+def test_porous_fused_runs():
+    # The flagship's fused production example on the virtual mesh
+    # (interpret-mode kernel; per-block (16, 32, 128) fits (8, 16) at w=2).
+    from jax.experimental.pallas import tpu as pltpu
+
+    import jax
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+
+    mod = _load("porous_convection3d_tpu_fused")
+    with pltpu.force_tpu_interpret_mode():
+        T = mod.porous_convection3d_fused(
+            nx=16, ny=32, nz=128, nt=2, w=2, npt=4, fused_tile=(8, 16),
+            quiet=True, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        )
+    assert np.isfinite(np.asarray(T)).all()
+    assert not igg.grid_is_initialized()
