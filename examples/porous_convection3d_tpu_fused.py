@@ -9,8 +9,9 @@ the PT loop sustains ~1050 GB/s/chip effective (8-pass convention, w=6) vs
 ~225 GB/s for the XLA path at the same size; the full time step (including
 the temperature update) lands at ~700-770 GB/s/PT-iter.
 
-``w`` must divide ``npt`` (the PT iterations per time step) and the minor
-dimension must be a multiple of 128, or the model falls back to XLA.
+``w`` must divide ``npt`` (the PT iterations per time step — a caller error
+otherwise); shapes outside the kernel envelope (e.g. a minor dimension that
+is not a multiple of 128) warn once and fall back to the XLA cadence.
 
 Run (any number of devices; overlap=12 enables the tuned w=6):
     python examples/porous_convection3d_tpu_fused.py [--nx 256] [--nt 24] [--w 6] [--npt 12]

@@ -254,10 +254,10 @@ def make_multi_step(
         if (bx is None) != (by is None):
             raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
 
-        def kernel_steps(P, Vxp, Vyp, Vzp):
+        def kernel_steps(P, Vxp, Vyp, Vzp, z_patches=None):
             return fused_leapfrog_steps(
                 P, Vxp, Vyp, Vzp, fused_k, cax, cay, caz, b, idx, idy, idz,
-                bx=bx, by=by,
+                bx=bx, by=by, z_patches=z_patches,
             )
 
         def xla_step(s):
@@ -265,11 +265,26 @@ def make_multi_step(
             Vx, Vy, Vz = v_update(P, Vx, Vy, Vz)
             return p_update(P, Vx, Vy, Vz), Vx, Vy, Vz
 
-        def fused_or_fallback(P, Vx, Vy, Vz, fused_body, xla_body):
-            err = fused_support_error(tuple(P.shape), fused_k, P.dtype.itemsize, bx, by)
+        z_active = dim_has_halo_activity(gg, 2)
+
+        def fused_or_fallback(P, Vx, Vy, Vz, fused_body, xla_body,
+                              zpatch_body=None):
+            shape = tuple(P.shape)
+            if (
+                zpatch_body is not None
+                and z_active
+                and fused_support_error(
+                    shape, fused_k, P.dtype.itemsize, bx, by, zpatch=True
+                ) is None
+            ):
+                # The in-kernel z-slab application: avoids the whole-array
+                # relayouts a z-dim DUS costs at the kernel boundary (the
+                # exchanged-dimension anisotropy, docs/performance.md).
+                return zpatch_body(P, Vx, Vy, Vz)
+            err = fused_support_error(shape, fused_k, P.dtype.itemsize, bx, by)
             if err is None:
                 return fused_body(P, Vx, Vy, Vz)
-            warn_fused_fallback(tuple(P.shape), fused_k, err, model="acoustic")
+            warn_fused_fallback(shape, fused_k, err, model="acoustic")
             return xla_body(P, Vx, Vy, Vz)
 
         if not active:
@@ -315,6 +330,37 @@ def make_multi_step(
             )
             return (P, *unpad_faces(Vxp, Vyp, Vzp))
 
+        def fused_zpatch_step(P, Vx, Vy, Vz):
+            from ..ops.halo import (
+                apply_z_patches,
+                identity_z_patches,
+                update_halo_padded_faces,
+                z_slab_patches,
+            )
+
+            s0 = (P, *pad_faces(Vx, Vy, Vz))
+            # Chunk entry has fresh halos, so the first group's z patches
+            # re-write the planes already in place.
+            patches0 = identity_z_patches(*s0, width=fused_k)
+
+            def group(i, carry):
+                s, patches = carry
+                # The kernel applies the z patches tile-by-tile in VMEM;
+                # x/y slabs exchange outside (major/second-minor DUS is
+                # cheap); the NEXT group's z patches are extracted after
+                # x/y (sequential-dimension corner semantics).
+                s = kernel_steps(*s, z_patches=patches)
+                s = update_halo_padded_faces(*s, width=fused_k, dims=(0, 1))
+                return s, z_slab_patches(*s, width=fused_k)
+
+            s, patches = lax.fori_loop(
+                0, nsteps // fused_k, group, (s0, patches0)
+            )
+            # One whole-array application restores the chunk-boundary
+            # fresh-halo invariant (amortized over the whole chunk).
+            P, Vxp, Vyp, Vzp = apply_z_patches(*s, patches, width=fused_k)
+            return (P, *unpad_faces(Vxp, Vyp, Vzp))
+
         def xla_cadence_step(P, Vx, Vy, Vz):
             def group(i, s):
                 s = lax.fori_loop(0, fused_k, lambda j, s: xla_step(s), s)
@@ -323,7 +369,9 @@ def make_multi_step(
             return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
 
         return stencil(
-            lambda *s: fused_or_fallback(*s, fused_block_step, xla_cadence_step),
+            lambda *s: fused_or_fallback(
+                *s, fused_block_step, xla_cadence_step, fused_zpatch_step
+            ),
             donate_argnums=tuple(range(4)) if donate else (),
         )
 

@@ -227,6 +227,27 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
     planes along *other* dimensions (full-extent slabs include the tail)
     is harmless.
     """
+    vals = _slab_recv_values(A, d, gg, width, logical)
+    if vals is None:
+        return A
+    lo_vals, hi_vals = vals
+    shp = logical if logical is not None else tuple(A.shape)
+    A = _set_plane(A, hi_vals, shp[d] - width, d)
+    A = _set_plane(A, lo_vals, 0, d)
+    return A
+
+
+def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
+    """The two slabs a ``d``-exchange of ``A`` would write, without writing.
+
+    Returns ``(lo_vals, hi_vals)`` — the values destined for planes
+    ``[0, width)`` and ``[n-width, n)`` (``n`` from ``logical`` when given)
+    — or ``None`` when the dimension exchanges nothing for this field.
+    `_exchange_dim` is get-values + two `_set_plane`s; the fused kernels'
+    z-patch path (`z_slab_patches`) uses the values directly, applying them
+    in VMEM where the minor-dim plane surgery is free (see
+    docs/performance.md's exchanged-dimension anisotropy note).
+    """
     import jax.numpy as jnp
     from jax import lax
 
@@ -234,10 +255,10 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
     if d >= len(shp):
         # A dimension beyond the field's rank can only ever be exchanged with a
         # self/absent neighbor (grid validation forces dims[d]==1, period 0).
-        return A
+        return None
     o = ol(d, shape=shp, gg=gg)
     if o < 2:
-        return A  # no halo in this dimension (reference: update_halo.jl:369)
+        return None  # no halo in this dimension (reference: update_halo.jl:369)
     n = shp[d]
     nd = gg.dims[d]
     periodic = bool(gg.periods[d])
@@ -245,7 +266,7 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
     if not dim_has_halo_activity(gg, d):
         # No partners at all: dims==1 non-periodic, or every distance-disp
         # shift falls off the grid (all partners PROC_NULL).
-        return A
+        return None
     if o < 2 * width:
         # Only dimensions that actually exchange need the deep halo.
         raise ValueError(
@@ -265,11 +286,10 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
         # Every block is its own partner (periodic wrap disp%nd==0, the
         # reference's self-neighbor fast path generalized, or disp==0):
         # pure local copy (reference: update_halo.jl:57-63).
-        lo_send = _get_plane(A, o - width, d, width)
-        hi_send = _get_plane(A, n - o, d, width)
-        A = _set_plane(A, lo_send, n - width, d)
-        A = _set_plane(A, hi_send, 0, d)
-        return A
+        return (
+            _get_plane(A, n - o, d, width),      # -> planes [0, width)
+            _get_plane(A, o - width, d, width),  # -> planes [n-width, n)
+        )
 
     axis = AXIS_NAMES[d]
     # Slabs go to the lower partner's top ``width`` planes / the upper
@@ -294,25 +314,17 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
             "igg.stencil (or jax.shard_map over igg's mesh axes 'x','y','z')."
         ) from e
     if periodic:
-        A = _set_plane(A, recv_hi, n - width, d)
-        A = _set_plane(A, recv_lo, 0, d)
-    else:
-        # Blocks whose shift falls off the grid have no source: ppermute
-        # delivered zeros there; keep the old boundary slab (the reference's
-        # PROC_NULL neighbors do nothing).
-        idx = lax.axis_index(axis)
-        has_upper = (idx + disp >= 0) & (idx + disp < nd)
-        has_lower = (idx - disp >= 0) & (idx - disp < nd)
-        A = _set_plane(
-            A,
-            jnp.where(has_upper, recv_hi, _get_plane(A, n - width, d, width)),
-            n - width,
-            d,
-        )
-        A = _set_plane(
-            A, jnp.where(has_lower, recv_lo, _get_plane(A, 0, d, width)), 0, d
-        )
-    return A
+        return recv_lo, recv_hi
+    # Blocks whose shift falls off the grid have no source: ppermute
+    # delivered zeros there; keep the old boundary slab (the reference's
+    # PROC_NULL neighbors do nothing).
+    idx = lax.axis_index(axis)
+    has_upper = (idx + disp >= 0) & (idx + disp < nd)
+    has_lower = (idx - disp >= 0) & (idx - disp < nd)
+    return (
+        jnp.where(has_lower, recv_lo, _get_plane(A, 0, d, width)),
+        jnp.where(has_upper, recv_hi, _get_plane(A, n - width, d, width)),
+    )
 
 
 def _update_halo_local(fields: tuple, gg, width: int = 1) -> tuple:
@@ -324,7 +336,87 @@ def _update_halo_local(fields: tuple, gg, width: int = 1) -> tuple:
     return tuple(out)
 
 
-def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1):
+def _padded_logicals(C, Axp, Ayp, Azp):
+    from .pallas_leapfrog import padded_face_shapes
+
+    n0, n1, n2 = C.shape
+    if (Axp.shape, Ayp.shape, Azp.shape) != padded_face_shapes(C.shape):
+        raise ValueError(
+            f"fields must be in pad_faces layout for cell shape {tuple(C.shape)}: "
+            f"got {Axp.shape}, {Ayp.shape}, {Azp.shape}"
+        )
+    return (None, (n0 + 1, n1, n2), (n0, n1 + 1, n2), (n0, n1, n2 + 1))
+
+
+def _pack_z_patch(lo, hi, width: int):
+    """Pack a field's two z slabs into one 128-lane array: lanes ``[0, w)``
+    = values for planes ``[0, w)``, lanes ``[w, 2w)`` = values for planes
+    ``[n-w, n)``, junk beyond — the layout the fused kernels' z-patch DMA
+    windows require (full-minor 128-lane fetches are the only lane-aligned
+    way to move a thin z slab; see the exchanged-dimension anisotropy note
+    in docs/performance.md)."""
+    import jax.numpy as jnp
+
+    packed = jnp.concatenate([lo, hi], axis=2)
+    return jnp.pad(packed, ((0, 0), (0, 0), (0, 128 - 2 * width)))
+
+
+def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1):
+    """The z-dimension exchange of the four fields, as packed patch arrays.
+
+    Returns ``(patch_C, patch_Ax, patch_Ay, patch_Az)`` (`_pack_z_patch`
+    layout, extents matching each PADDED array's x/y extents so kernel tile
+    windows slice them with the same aligned offsets), or ``None`` when the
+    z dimension exchanges nothing.  Must be called AFTER the x/y exchanges
+    (sequential-dimension corner semantics).  The patches are consumed by
+    the fused kernels, which apply them to their VMEM tiles where minor-dim
+    plane surgery is free — instead of the whole-array relayouts a
+    z-`dynamic-update-slice` costs at a kernel boundary.
+    """
+    gg = _grid.global_grid()
+    logicals = _padded_logicals(C, Axp, Ayp, Azp)
+    out = []
+    for A, logical in zip((C, Axp, Ayp, Azp), logicals):
+        vals = _slab_recv_values(A, 2, gg, width, logical)
+        if vals is None:
+            return None  # all-or-nothing: z activity is per-grid, not per-field
+        out.append(_pack_z_patch(*vals, width))
+    return tuple(out)
+
+
+def identity_z_patches(C, Axp, Ayp, Azp, *, width: int = 1):
+    """Patches that re-write the CURRENT z-halo planes (a no-op application).
+
+    The chunk-entry state has fresh halos (the models' chunk-boundary
+    invariant), so the first fused group's patches are the planes already
+    in place."""
+    logicals = _padded_logicals(C, Axp, Ayp, Azp)
+    out = []
+    for A, logical in zip((C, Axp, Ayp, Azp), logicals):
+        n = (logical or tuple(A.shape))[2]
+        lo = _get_plane(A, 0, 2, width)
+        hi = _get_plane(A, n - width, 2, width)
+        out.append(_pack_z_patch(lo, hi, width))
+    return tuple(out)
+
+
+def apply_z_patches(C, Axp, Ayp, Azp, patches, *, width: int = 1):
+    """Write packed z patches into the arrays (the chunk-end restoration).
+
+    One whole-array `dynamic-update-slice` pass per field — paid once per
+    CHUNK (the in-kernel application covers every group in between), so the
+    relayout cost amortizes over ``nsteps``."""
+    logicals = _padded_logicals(C, Axp, Ayp, Azp)
+    out = []
+    for A, logical, patch in zip((C, Axp, Ayp, Azp), logicals, patches):
+        n = (logical or tuple(A.shape))[2]
+        A = _set_plane(A, patch[:, :, :width], 0, 2)
+        A = _set_plane(A, patch[:, :, width : 2 * width], n - width, 2)
+        out.append(A)
+    return tuple(out)
+
+
+def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1, dims=None):
     """Slab-exchange a cell field + three `pad_faces`-layout staggered fields.
 
     The models' fused deep-halo cadences keep the staggered fields in the
@@ -336,27 +428,17 @@ def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1):
     move; only the junk tail differs (it receives exchanged junk instead of
     zeros, and the layout's contract already forbids reading it).
 
+    ``dims``: restrict the exchange to these dimensions (default all) — the
+    z-patch cadence exchanges x/y here and routes z through `z_slab_patches`
+    into the kernel.
+
     Tracer-context only (inside `stencil`/shard_map — where the fused block
     steps live); the public `update_halo` remains the global-array entry.
     """
-    from ..parallel import grid as _g
-    from .pallas_leapfrog import padded_face_shapes
-
-    gg = _g.global_grid()
-    n0, n1, n2 = C.shape
-    if (Axp.shape, Ayp.shape, Azp.shape) != padded_face_shapes(C.shape):
-        raise ValueError(
-            f"fields must be in pad_faces layout for cell shape {tuple(C.shape)}: "
-            f"got {Axp.shape}, {Ayp.shape}, {Azp.shape}"
-        )
-    logicals = (
-        None,
-        (n0 + 1, n1, n2),
-        (n0, n1 + 1, n2),
-        (n0, n1, n2 + 1),
-    )
+    gg = _grid.global_grid()
+    logicals = _padded_logicals(C, Axp, Ayp, Azp)
     out = [C, Axp, Ayp, Azp]
-    for d in range(NDIMS):
+    for d in range(NDIMS) if dims is None else dims:
         for i in range(len(out)):
             out[i] = _exchange_dim(out[i], d, gg, width, logical=logicals[i])
     return tuple(out)

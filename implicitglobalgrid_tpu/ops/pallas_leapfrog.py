@@ -82,8 +82,11 @@ _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize):
-    """VMEM bytes for one full ping-pong set (4 fields x (2 slots + scratch))."""
+def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
+    """VMEM bytes for one full ping-pong set (4 fields x (2 slots + scratch)).
+
+    ``zpatch``: add the four double-buffered 128-lane z-patch windows (the
+    in-kernel z-exchange application, `z_slab_patches`)."""
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     per_set = (
@@ -92,23 +95,36 @@ def _tile_bytes(n2, k, bx, by, itemsize):
         + SX * (SY + 8) * n2  # Vy
         + SX * SY * (n2 + 128)  # Vz (minor pad is a full lane tile)
     )
-    return 3 * per_set * itemsize
+    total = 3 * per_set
+    if zpatch:
+        total += 2 * 128 * (
+            SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
+        )
+    return total * itemsize
 
 
 _tile_error = _envelope.make_tile_error(
     _tile_bytes, _VMEM_BUDGET_BYTES, "12 haloed staggered tiles spanning z"
 )
+_tile_error_zpatch = _envelope.make_tile_error(
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, True),
+    _VMEM_BUDGET_BYTES,
+    "12 haloed staggered tiles spanning z + 8 z-patch windows",
+)
 
 
-def default_tile(shape, k: int, itemsize: int = 4):
+def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False):
     """First tuned tile candidate valid for cell ``shape``, or None."""
     return _envelope.default_tile(
-        shape, k, itemsize, tile_error=_tile_error, candidates=_TILE_CANDIDATES
+        shape, k, itemsize,
+        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_support_error(shape, k: int, itemsize: int = 4,
-                        bx: int | None = None, by: int | None = None) -> str | None:
+                        bx: int | None = None, by: int | None = None,
+                        zpatch: bool = False) -> str | None:
     """Why the fused leapfrog kernel cannot run this cell shape, or None.
 
     Single source of truth for the kernel envelope — used eagerly by
@@ -117,11 +133,13 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     runtime-path-selection precedent, `/root/reference/src/update_halo.jl:755-784`).
     Kernel-independent checks live in `ops/_fused_envelope.py`, shared with
     the diffusion kernel; only `_tile_error`'s 12-buffer VMEM accounting is
-    specific.
+    specific.  ``zpatch`` accounts for the in-kernel z-exchange variant's
+    extra patch windows.
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_tile_error, candidates=_TILE_CANDIDATES,
+        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        candidates=_TILE_CANDIDATES,
     )
 
 
@@ -173,10 +191,22 @@ def unpad_faces(Vxp, Vyp, Vzp):
     )
 
 
+def z_patch_shapes(cell_shape):
+    """The four packed z-patch array shapes (`ops.halo.z_slab_patches`)."""
+    n0, n1, n2 = cell_shape
+    return (
+        (n0, n1, 128),
+        (n0 + PADS[0], n1, 128),
+        (n0, n1 + PADS[1], 128),
+        (n0, n1, 128),
+    )
+
+
 def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
                          cax: float, cay: float, caz: float,
                          b: float, idx: float, idy: float, idz: float,
-                         *, bx: int | None = None, by: int | None = None):
+                         *, bx: int | None = None, by: int | None = None,
+                         z_patches=None):
     """Advance ``k`` (even) leapfrog steps in one HBM pass per field.
 
     ``P`` is the cell-centered pressure ``(n0, n1, n2)``; ``Vxp/Vyp/Vzp`` are
@@ -184,6 +214,13 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
     Coefficients: ``cax = dt/(rho*dx)`` (likewise y, z); ``b = dt*K``;
     ``idx = 1/dx`` (likewise y, z) — the same folds as the XLA model so the
     two paths differ only by FMA contraction.
+
+    ``z_patches``: packed z-exchange patches (`ops.halo.z_slab_patches`,
+    width ``k``) applied to each tile in VMEM before stepping — the
+    in-kernel z-slab application that avoids whole-array relayouts at the
+    kernel boundary (see the exchanged-dimension anisotropy note in
+    docs/performance.md).  Lanes ``[0, k)`` overwrite each field's z planes
+    ``[0, k)``, lanes ``[k, 2k)`` its planes ``[n_z - k, n_z)``.
     """
     n0, n1, n2 = P.shape
     if (Vxp.shape, Vyp.shape, Vzp.shape) != padded_face_shapes(P.shape):
@@ -193,19 +230,32 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
         )
     if not (P.dtype == Vxp.dtype == Vyp.dtype == Vzp.dtype):
         raise ValueError("P and V fields must share a dtype")
-    err = fused_support_error((n0, n1, n2), k, P.dtype.itemsize, bx, by)
+    zp = z_patches is not None
+    if zp:
+        if tuple(a.shape for a in z_patches) != z_patch_shapes(P.shape):
+            raise ValueError(
+                f"z_patches must have shapes {z_patch_shapes(P.shape)}: got "
+                f"{tuple(a.shape for a in z_patches)}"
+            )
+        if any(a.dtype != P.dtype for a in z_patches):
+            raise ValueError("z_patches must share the fields' dtype")
+    err = fused_support_error((n0, n1, n2), k, P.dtype.itemsize, bx, by, zpatch=zp)
     if err is not None:
         raise ValueError(err)
     if bx is None:
-        bx, by = default_tile((n0, n1, n2), k, P.dtype.itemsize)
-    return _build(n0, n1, n2, str(P.dtype), int(k),
-                  float(cax), float(cay), float(caz),
-                  float(b), float(idx), float(idy), float(idz),
-                  int(bx), int(by))(P, Vxp, Vyp, Vzp)
+        bx, by = default_tile((n0, n1, n2), k, P.dtype.itemsize, zpatch=zp)
+    fn = _build(n0, n1, n2, str(P.dtype), int(k),
+                float(cax), float(cay), float(caz),
+                float(b), float(idx), float(idy), float(idz),
+                int(bx), int(by), zp)
+    if zp:
+        return fn(P, Vxp, Vyp, Vzp, *z_patches)
+    return fn(P, Vxp, Vyp, Vzp)
 
 
 @functools.lru_cache(maxsize=64)
-def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
+def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
+           zp: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -287,9 +337,17 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
         )
         dp[:] = P - b * div
 
-    def kernel(Pin, Vxin, Vyin, Vzin, Pout, Vxout, Vyout, Vzout):
+    def kernel(*refs):
+        if zp:
+            (Pin, Vxin, Vyin, Vzin, ZPp, ZPx, ZPy, ZPz,
+             Pout, Vxout, Vyout, Vzout) = refs
+        else:
+            Pin, Vxin, Vyin, Vzin, Pout, Vxout, Vyout, Vzout = refs
+            ZPp = ZPx = ZPy = ZPz = None
+
         def body(p, vx, vy, vz, sp, svx, svy, svz,
-                 p_is, vx_is, vy_is, vz_is, p_os, vx_os, vy_os, vz_os, fix_s):
+                 p_is, vx_is, vy_is, vz_is, p_os, vx_os, vy_os, vz_os, fix_s,
+                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None):
             def ixy(t):
                 return t // ncy, t % ncy
 
@@ -312,7 +370,26 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
                         Vzin.at[pl.ds(sx, SX), pl.ds(sy, SY)],
                         vz.at[slot], vz_is.at[slot],
                     ),
-                )
+                ) + ((
+                    # z-patch windows (full-minor 128-lane fetch, the only
+                    # lane-aligned way to move a thin z slab per tile).
+                    pltpu.make_async_copy(
+                        ZPp.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        zpp.at[slot], zp_is.at[0, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        ZPx.at[pl.ds(sx, SX + 8), pl.ds(sy, SY)],
+                        zpx.at[slot], zp_is.at[1, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        ZPy.at[pl.ds(sx, SX), pl.ds(sy, SY + 8)],
+                        zpy.at[slot], zp_is.at[2, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        ZPz.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        zpz.at[slot], zp_is.at[3, slot],
+                    ),
+                ) if zp else ())
 
             def out_dmas(t, slot):
                 ix, iy = ixy(t)
@@ -385,6 +462,20 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
                     start_in(t + 1, nslot)
 
                 wait_in(t, slot)
+                if zp:
+                    # Apply the z-exchange patches to this tile in VMEM
+                    # (minor-dim plane surgery is free here, unlike the
+                    # whole-array relayout a z-DUS costs at the kernel
+                    # boundary): lanes [0,k) -> planes [0,k), lanes [k,2k)
+                    # -> the top k planes of each field's REAL z extent.
+                    p[slot, :, :, 0:k] = zpp[slot, :, :, 0:k]
+                    p[slot, :, :, SZ - k : SZ] = zpp[slot, :, :, k : 2 * k]
+                    vx[slot, :, :, 0:k] = zpx[slot, :, :, 0:k]
+                    vx[slot, :, :, SZ - k : SZ] = zpx[slot, :, :, k : 2 * k]
+                    vy[slot, :, :, 0:k] = zpy[slot, :, :, 0:k]
+                    vy[slot, :, :, SZ - k : SZ] = zpy[slot, :, :, k : 2 * k]
+                    vz[slot, :, :, 0:k] = zpz[slot, :, :, 0:k]
+                    vz[slot, :, :, SZ + 1 - k : SZ + 1] = zpz[slot, :, :, k : 2 * k]
                 # k-step ping-pong between the in-slot set and the scratch
                 # set; k even, so the final state lands back in the slot.
                 for j in range(k):
@@ -411,8 +502,7 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
             fix_vx.wait()
             fix_vy.wait()
 
-        pl.run_scoped(
-            body,
+        scopes = dict(
             p=pltpu.VMEM((2, SX, SY, SZ), dt_),
             vx=pltpu.VMEM((2, SX + 8, SY, SZ), dt_),
             vy=pltpu.VMEM((2, SX, SY + 8, SZ), dt_),
@@ -431,8 +521,17 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
             vz_os=pltpu.SemaphoreType.DMA((2,)),
             fix_s=pltpu.SemaphoreType.DMA((2,)),
         )
+        if zp:
+            scopes.update(
+                zpp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zpx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
+                zpy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
+                zpz=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zp_is=pltpu.SemaphoreType.DMA((4, 2)),
+            )
+        pl.run_scoped(body, **scopes)
 
-    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize)
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
     call = pl.pallas_call(
         kernel,
         out_shape=(
@@ -441,7 +540,7 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by):
             jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
             jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (8 if zp else 4),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=min(110 * 1024 * 1024, vmem_bytes + 16 * 1024 * 1024)
