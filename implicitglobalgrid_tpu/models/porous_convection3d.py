@@ -425,10 +425,10 @@ def make_multi_step(
         if (bx is None) != (by is None):
             raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
 
-        def kernel_iters(T, Pf, qxp, qyp, qzp):
+        def kernel_iters(T, Pf, qxp, qyp, qzp, z_patches=None):
             return fused_pt_iterations(
                 T, Pf, qxp, qyp, qzp, w, th, idx, idy, idz, ralam, bp,
-                bx=bx, by=by,
+                bx=bx, by=by, z_patches=z_patches,
             )
 
         if not active:
@@ -469,13 +469,51 @@ def make_multi_step(
                 T = update_halo(T)
                 return T, Pf, qDx, qDy, qDz
 
+            def fused_zpatch_step(T, Pf, qDx, qDy, qDz):
+                from ..ops.halo import (
+                    apply_z_patches,
+                    identity_z_patches,
+                    update_halo_padded_faces,
+                    z_slab_patches,
+                )
+
+                s0 = (Pf, *pad_faces(qDx, qDy, qDz))
+                patches0 = identity_z_patches(*s0, width=w)
+
+                def group(i, carry):
+                    s, patches = carry
+                    # In-kernel z-slab application + outside x/y exchange
+                    # (see acoustic3d's fused_zpatch_step / the anisotropy
+                    # note in docs/performance.md).
+                    s = kernel_iters(T, *s, z_patches=patches)
+                    s = update_halo_padded_faces(*s, width=w, dims=(0, 1))
+                    return s, z_slab_patches(*s, width=w)
+
+                s, patches = lax.fori_loop(0, npt // w, group, (s0, patches0))
+                Pf, qxp, qyp, qzp = apply_z_patches(*s, patches, width=w)
+                qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
+                T = t_update(T, qDx, qDy, qDz)
+                T = update_halo(T)
+                return T, Pf, qDx, qDy, qDz
+
         xla_block_step = cadence_block_step(w)
+        z_active = dim_has_halo_activity(gg, 2)
 
         def block_step(T, Pf, qDx, qDy, qDz):
             # Shapes are only known at trace time, so the kernel-vs-fallback
             # choice happens there (the reference's runtime-path-selection
             # move, `/root/reference/src/update_halo.jl:755-784`).
-            err = fused_support_error(tuple(Pf.shape), w, Pf.dtype.itemsize, bx, by)
+            shape = tuple(Pf.shape)
+            if (
+                active
+                and z_active
+                and fused_support_error(
+                    shape, w, Pf.dtype.itemsize, bx, by, zpatch=True
+                ) is None
+            ):
+                # In-kernel z-slab application (see docs/performance.md).
+                return fused_zpatch_step(T, Pf, qDx, qDy, qDz)
+            err = fused_support_error(shape, w, Pf.dtype.itemsize, bx, by)
             if err is None:
                 return fused_block_step(T, Pf, qDx, qDy, qDz)
             warn_fused_fallback(tuple(Pf.shape), w, err, model="porous")

@@ -43,6 +43,7 @@ from .pallas_leapfrog import (  # noqa: F401  (re-export)
     pad_faces,
     padded_face_shapes,
     unpad_faces,
+    z_patch_shapes,
 )
 
 _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
@@ -52,8 +53,9 @@ _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize):
-    """VMEM bytes: 4 ping-pong fields x (2 slots + scratch) + 2 T slots."""
+def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
+    """VMEM bytes: 4 ping-pong fields x (2 slots + scratch) + 2 T slots
+    (+ the double-buffered 128-lane z-patch windows when ``zpatch``)."""
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     per_set = (
@@ -62,38 +64,56 @@ def _tile_bytes(n2, k, bx, by, itemsize):
         + SX * (SY + 8) * n2    # qDy
         + SX * SY * (n2 + 128)  # qDz
     )
-    return (3 * per_set + 2 * SX * SY * n2) * itemsize
+    total = 3 * per_set + 2 * SX * SY * n2
+    if zpatch:
+        total += 2 * 128 * (
+            SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
+        )
+    return total * itemsize
 
 
 _tile_error = _envelope.make_tile_error(
     _tile_bytes, _VMEM_BUDGET_BYTES, "14 haloed staggered tiles spanning z"
 )
+_tile_error_zpatch = _envelope.make_tile_error(
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, True),
+    _VMEM_BUDGET_BYTES,
+    "14 haloed staggered tiles spanning z + 8 z-patch windows",
+)
 
 
-def default_tile(shape, k: int, itemsize: int = 4):
+def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False):
     """First tuned tile candidate valid for cell ``shape``, or None."""
     return _envelope.default_tile(
-        shape, k, itemsize, tile_error=_tile_error, candidates=_TILE_CANDIDATES
+        shape, k, itemsize,
+        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_support_error(shape, k: int, itemsize: int = 4,
-                        bx: int | None = None, by: int | None = None) -> str | None:
+                        bx: int | None = None, by: int | None = None,
+                        zpatch: bool = False) -> str | None:
     """Why the fused PT kernel cannot run this cell shape, or None.
 
     Shared control flow in `ops/_fused_envelope.py`; only `_tile_error`'s
-    14-buffer VMEM accounting is specific.
+    14-buffer VMEM accounting is specific.  ``zpatch`` accounts for the
+    in-kernel z-exchange variant (PT fields only — ``T`` is frozen through
+    the PT loop, its halos are refreshed at its own once-per-step exchange,
+    so it needs no patches).
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_tile_error, candidates=_TILE_CANDIDATES,
+        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
                         th: float, idx: float, idy: float, idz: float,
                         ralam: float, bp: float,
-                        *, bx: int | None = None, by: int | None = None):
+                        *, bx: int | None = None, by: int | None = None,
+                        z_patches=None):
     """Advance ``k`` (even) PT relaxation iterations in one HBM pass per field.
 
     ``T``/``Pf`` are cell-centered ``(n0, n1, n2)``; ``qxp/qyp/qzp`` are the
@@ -101,6 +121,10 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
     ``th`` = flux relaxation, ``idx = 1/dx`` (likewise y, z), ``ralam =
     Ra*lam_T`` (buoyancy), ``bp`` = pressure relaxation.  Returns
     ``(Pf, qxp, qyp, qzp)`` — ``T`` is read-only.
+
+    ``z_patches``: packed z-exchange patches for the four PT fields
+    (`ops.halo.z_slab_patches`, width ``k``), applied per tile in VMEM —
+    see `ops.pallas_leapfrog.fused_leapfrog_steps`.
     """
     n0, n1, n2 = Pf.shape
     if T.shape != Pf.shape:
@@ -112,18 +136,31 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
         )
     if not (T.dtype == Pf.dtype == qxp.dtype == qyp.dtype == qzp.dtype):
         raise ValueError("T, Pf and flux fields must share a dtype")
-    err = fused_support_error((n0, n1, n2), k, Pf.dtype.itemsize, bx, by)
+    zp = z_patches is not None
+    if zp:
+        if tuple(a.shape for a in z_patches) != z_patch_shapes(Pf.shape):
+            raise ValueError(
+                f"z_patches must have shapes {z_patch_shapes(Pf.shape)}: got "
+                f"{tuple(a.shape for a in z_patches)}"
+            )
+        if any(a.dtype != Pf.dtype for a in z_patches):
+            raise ValueError("z_patches must share the fields' dtype")
+    err = fused_support_error((n0, n1, n2), k, Pf.dtype.itemsize, bx, by, zpatch=zp)
     if err is not None:
         raise ValueError(err)
     if bx is None:
-        bx, by = default_tile((n0, n1, n2), k, Pf.dtype.itemsize)
-    return _build(n0, n1, n2, str(Pf.dtype), int(k),
-                  float(th), float(idx), float(idy), float(idz),
-                  float(ralam), float(bp), int(bx), int(by))(T, Pf, qxp, qyp, qzp)
+        bx, by = default_tile((n0, n1, n2), k, Pf.dtype.itemsize, zpatch=zp)
+    fn = _build(n0, n1, n2, str(Pf.dtype), int(k),
+                float(th), float(idx), float(idy), float(idz),
+                float(ralam), float(bp), int(bx), int(by), zp)
+    if zp:
+        return fn(T, Pf, qxp, qyp, qzp, *z_patches)
+    return fn(T, Pf, qxp, qyp, qzp)
 
 
 @functools.lru_cache(maxsize=64)
-def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
+def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
+           zp: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -204,10 +241,18 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
         )
         dp[:] = P - bp * div
 
-    def kernel(Tin, Pfin, Qxin, Qyin, Qzin, Pfout, Qxout, Qyout, Qzout):
+    def kernel(*refs):
+        if zp:
+            (Tin, Pfin, Qxin, Qyin, Qzin, ZPp, ZPx, ZPy, ZPz,
+             Pfout, Qxout, Qyout, Qzout) = refs
+        else:
+            Tin, Pfin, Qxin, Qyin, Qzin, Pfout, Qxout, Qyout, Qzout = refs
+            ZPp = ZPx = ZPy = ZPz = None
+
         def body(t, p, qx, qy, qz, sp, sqx, sqy, sqz,
                  t_is, p_is, qx_is, qy_is, qz_is,
-                 p_os, qx_os, qy_os, qz_os, fix_s):
+                 p_os, qx_os, qy_os, qz_os, fix_s,
+                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None):
             def ixy(tt):
                 return tt // ncy, tt % ncy
 
@@ -233,7 +278,24 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
                         Qzin.at[pl.ds(sx, SX), pl.ds(sy, SY)],
                         qz.at[slot], qz_is.at[slot],
                     ),
-                )
+                ) + ((
+                    pltpu.make_async_copy(
+                        ZPp.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        zpp.at[slot], zp_is.at[0, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        ZPx.at[pl.ds(sx, SX + 8), pl.ds(sy, SY)],
+                        zpx.at[slot], zp_is.at[1, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        ZPy.at[pl.ds(sx, SX), pl.ds(sy, SY + 8)],
+                        zpy.at[slot], zp_is.at[2, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        ZPz.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        zpz.at[slot], zp_is.at[3, slot],
+                    ),
+                ) if zp else ())
 
             def out_dmas(tt, slot):
                 ix, iy = ixy(tt)
@@ -302,6 +364,18 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
                     start_in(tt + 1, nslot)
 
                 wait_in(tt, slot)
+                if zp:
+                    # Apply the z-exchange patches in VMEM (see the
+                    # leapfrog kernel): lanes [0,k) -> planes [0,k),
+                    # lanes [k,2k) -> the top k planes of each field.
+                    p[slot, :, :, 0:k] = zpp[slot, :, :, 0:k]
+                    p[slot, :, :, SZ - k : SZ] = zpp[slot, :, :, k : 2 * k]
+                    qx[slot, :, :, 0:k] = zpx[slot, :, :, 0:k]
+                    qx[slot, :, :, SZ - k : SZ] = zpx[slot, :, :, k : 2 * k]
+                    qy[slot, :, :, 0:k] = zpy[slot, :, :, 0:k]
+                    qy[slot, :, :, SZ - k : SZ] = zpy[slot, :, :, k : 2 * k]
+                    qz[slot, :, :, 0:k] = zpz[slot, :, :, 0:k]
+                    qz[slot, :, :, SZ + 1 - k : SZ + 1] = zpz[slot, :, :, k : 2 * k]
                 tv = t[slot]
                 for j in range(k):
                     if j % 2 == 0:
@@ -325,8 +399,7 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
             fix_qx.wait()
             fix_qy.wait()
 
-        pl.run_scoped(
-            body,
+        scopes = dict(
             t=pltpu.VMEM((2, SX, SY, SZ), dt_),
             p=pltpu.VMEM((2, SX, SY, SZ), dt_),
             qx=pltpu.VMEM((2, SX + 8, SY, SZ), dt_),
@@ -347,8 +420,17 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
             qz_os=pltpu.SemaphoreType.DMA((2,)),
             fix_s=pltpu.SemaphoreType.DMA((2,)),
         )
+        if zp:
+            scopes.update(
+                zpp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zpx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
+                zpy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
+                zpz=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zp_is=pltpu.SemaphoreType.DMA((4, 2)),
+            )
+        pl.run_scoped(body, **scopes)
 
-    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize)
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
     call = pl.pallas_call(
         kernel,
         out_shape=(
@@ -357,7 +439,7 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by):
             jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
             jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (9 if zp else 5),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=min(110 * 1024 * 1024, vmem_bytes + 16 * 1024 * 1024)
