@@ -268,16 +268,27 @@ def make_multi_step(
             # cannot run — raise eagerly rather than warn-and-fall-back.
             raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
 
+        z_active = dim_has_halo_activity(gg, 2)
+
         # Shapes are only known at trace time, so the kernel-vs-fallback
         # choice happens there: a local block the kernel's envelope rejects
         # warns once and runs the XLA path at the SAME exchange cadence
         # (w steps per width-w slab exchange — the deep halo is already
         # validated above), the reference's runtime-path-selection move
         # (`/root/reference/src/update_halo.jl:755-784`).
-        def fused_or_fallback(T, Cp, fused_body, xla_body):
-            err = fused_support_error(
-                tuple(T.shape), fused_k, T.dtype.itemsize, bx, by
-            )
+        def fused_or_fallback(T, Cp, fused_body, xla_body, zpatch_body=None):
+            shape = tuple(T.shape)
+            if (
+                zpatch_body is not None
+                and z_active
+                and fused_support_error(
+                    shape, fused_k, T.dtype.itemsize, bx, by, zpatch=True
+                ) is None
+            ):
+                # In-kernel z-slab application (docs/performance.md's
+                # exchanged-dimension anisotropy note).
+                return zpatch_body(T, Cp)
+            err = fused_support_error(shape, fused_k, T.dtype.itemsize, bx, by)
             if err is None:
                 return fused_body(T, Cp)
             _warn_fused_fallback(tuple(T.shape), fused_k, err)
@@ -315,6 +326,31 @@ def make_multi_step(
 
             return lax.fori_loop(0, nsteps // fused_k, body, T), Cp
 
+        def fused_zpatch_step(T, Cp):
+            from ..ops.halo import (
+                apply_z_patch,
+                exchange_dims,
+                identity_z_patch,
+                z_slab_patch,
+            )
+
+            def group(i, carry):
+                T, patch = carry
+                # The kernel applies the z patch per tile in VMEM; x/y
+                # slabs exchange outside (cheap DUS); next patch extracted
+                # after x/y (corner semantics).
+                T = fused_diffusion_steps(
+                    T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch
+                )
+                T = exchange_dims(T, (0, 1), width=fused_k)
+                return T, z_slab_patch(T, width=fused_k)
+
+            T, patch = lax.fori_loop(
+                0, nsteps // fused_k, group,
+                (T, identity_z_patch(T, width=fused_k)),
+            )
+            return apply_z_patch(T, patch, width=fused_k), Cp
+
         def xla_cadence_step(T, Cp):
             def group(i, T):
                 T = lax.fori_loop(0, fused_k, lambda j, T: update(T, Cp), T)
@@ -323,7 +359,9 @@ def make_multi_step(
             return lax.fori_loop(0, nsteps // fused_k, group, T), Cp
 
         return stencil(
-            lambda T, Cp: fused_or_fallback(T, Cp, fused_block_step, xla_cadence_step),
+            lambda T, Cp: fused_or_fallback(
+                T, Cp, fused_block_step, xla_cadence_step, fused_zpatch_step
+            ),
             donate_argnums=(0,) if donate else (),
         )
 

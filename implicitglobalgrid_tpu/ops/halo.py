@@ -361,6 +361,43 @@ def _pack_z_patch(lo, hi, width: int):
     return jnp.pad(packed, ((0, 0), (0, 0), (0, 128 - 2 * width)))
 
 
+def z_slab_patch(A, *, width: int = 1):
+    """Single-field version of `z_slab_patches` (the diffusion kernel's T).
+
+    Returns the packed 128-lane patch for a plain cell field, or None when
+    the z dimension exchanges nothing."""
+    gg = _grid.global_grid()
+    vals = _slab_recv_values(A, 2, gg, width)
+    if vals is None:
+        return None
+    return _pack_z_patch(*vals, width)
+
+
+def identity_z_patch(A, *, width: int = 1):
+    """Single-field `identity_z_patches` (re-writes the current z planes)."""
+    n = A.shape[2]
+    return _pack_z_patch(
+        _get_plane(A, 0, 2, width), _get_plane(A, n - width, 2, width), width
+    )
+
+
+def apply_z_patch(A, patch, *, width: int = 1):
+    """Single-field `apply_z_patches` (the chunk-end restoration)."""
+    n = A.shape[2]
+    A = _set_plane(A, patch[:, :, :width], 0, 2)
+    return _set_plane(A, patch[:, :, width : 2 * width], n - width, 2)
+
+
+def exchange_dims(A, dims, *, width: int = 1):
+    """Exchange a single field along the given dimensions only (traced
+    context; the z-patch cadences exchange x/y here and route z through
+    the kernel)."""
+    gg = _grid.global_grid()
+    for d in dims:
+        A = _exchange_dim(A, d, gg, width)
+    return A
+
+
 def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1):
     """The z-dimension exchange of the four fields, as packed patch arrays.
 

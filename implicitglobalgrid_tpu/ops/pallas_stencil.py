@@ -65,10 +65,15 @@ _TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 100 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize):
-    """VMEM bytes for the 5-tile working set (2 T slots, 2 Cp slots, scratch)."""
+def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
+    """VMEM bytes for the 5-tile working set (2 T slots, 2 Cp slots, scratch)
+    plus the double-buffered 128-lane z-patch windows when ``zpatch``
+    (``Cp`` is frozen — only ``T`` carries patches)."""
     H = _envelope.aligned_halo(k)
-    return 5 * (bx + 2 * k) * (by + 2 * H) * n2 * itemsize
+    total = 5 * (bx + 2 * k) * (by + 2 * H) * n2
+    if zpatch:
+        total += 2 * (bx + 2 * k) * (by + 2 * H) * 128
+    return total * itemsize
 
 
 # (by | n1 and by + 2H <= n1 with H >= 8 already force >= 2 y-tiles.)
@@ -76,17 +81,25 @@ _tile_error = _envelope.make_tile_error(
     _tile_bytes, _VMEM_BUDGET_BYTES,
     "5 haloed tiles spanning z, v5e-tuned — see _VMEM_BUDGET_BYTES",
 )
+_tile_error_zpatch = _envelope.make_tile_error(
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, True),
+    _VMEM_BUDGET_BYTES,
+    "5 haloed tiles spanning z + 2 z-patch windows",
+)
 
 
-def default_tile(shape, k: int, itemsize: int = 4):
+def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False):
     """First tuned tile candidate valid for ``shape``, or None if none fits."""
     return _envelope.default_tile(
-        shape, k, itemsize, tile_error=_tile_error, candidates=_TILE_CANDIDATES
+        shape, k, itemsize,
+        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_support_error(shape, k: int, itemsize: int = 4,
-                        bx: int | None = None, by: int | None = None) -> str | None:
+                        bx: int | None = None, by: int | None = None,
+                        zpatch: bool = False) -> str | None:
     """Why the fused kernel cannot run this config, or None if it can.
 
     The single source of truth for the kernel's shape/tile envelope — used
@@ -97,36 +110,56 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     checks (k parity, minor-dim ceiling + lane alignment, tile-selection
     flow) live in `ops/_fused_envelope.py`, shared with the staggered
     leapfrog kernel; only `_tile_error`'s VMEM accounting is specific.
+    ``zpatch`` accounts for the in-kernel z-exchange variant's T patch
+    windows.
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_tile_error, candidates=_TILE_CANDIDATES,
+        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
-                          *, bx: int | None = None, by: int | None = None):
+                          *, bx: int | None = None, by: int | None = None,
+                          z_patch=None):
     """Advance ``k`` (even) diffusion steps in one HBM pass.
 
     ``cx = dt*lam/dx^2`` (likewise ``cy``, ``cz``); ``(bx, by)`` = output
     tile: ``bx`` divides ``T.shape[0]``; ``by`` divides ``T.shape[1]`` and is
     a multiple of 8; the haloed tile must fit inside the array.  Defaults to
     the fastest valid `_TILE_CANDIDATES` entry for the volume.
+
+    ``z_patch``: packed z-exchange patch for ``T`` (`ops.halo.z_slab_patch`,
+    width ``k``, shape ``(n0, n1, 128)``) applied per tile in VMEM before
+    stepping — see `ops.pallas_leapfrog.fused_leapfrog_steps` (``Cp`` is
+    frozen; its halos never change, so it needs no patch).
     """
     n0, n1, n2 = T.shape
     if T.dtype != Cp.dtype:
         raise ValueError("T and Cp must share a dtype")
-    err = fused_support_error((n0, n1, n2), k, T.dtype.itemsize, bx, by)
+    zp = z_patch is not None
+    if zp:
+        if tuple(z_patch.shape) != (n0, n1, 128):
+            raise ValueError(
+                f"z_patch must have shape {(n0, n1, 128)}: got {tuple(z_patch.shape)}"
+            )
+        if z_patch.dtype != T.dtype:
+            raise ValueError("z_patch must share T's dtype")
+    err = fused_support_error((n0, n1, n2), k, T.dtype.itemsize, bx, by, zpatch=zp)
     if err is not None:
         raise ValueError(err)
     if bx is None:
-        bx, by = default_tile((n0, n1, n2), k, T.dtype.itemsize)
-    return _build(n0, n1, n2, str(T.dtype), int(k),
-                  float(cx), float(cy), float(cz), int(bx), int(by))(T, Cp)
+        bx, by = default_tile((n0, n1, n2), k, T.dtype.itemsize, zpatch=zp)
+    fn = _build(n0, n1, n2, str(T.dtype), int(k),
+                float(cx), float(cy), float(cz), int(bx), int(by), zp)
+    if zp:
+        return fn(T, Cp, z_patch)
+    return fn(T, Cp)
 
 
 @functools.lru_cache(maxsize=64)
-def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
+def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -181,8 +214,15 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
 
     ntiles = ncx * ncy
 
-    def kernel(Tin, Cpin, Tout):
-        def body(tin, cpin, scratch, in_sems, cp_sems, out_sems):
+    def kernel(*refs):
+        if zp:
+            Tin, Cpin, ZPin, Tout = refs
+        else:
+            Tin, Cpin, Tout = refs
+            ZPin = None
+
+        def body(tin, cpin, scratch, in_sems, cp_sems, out_sems,
+                 zpin=None, zp_sems=None):
             # One flat tile index t = ix*ncy + iy; slot parity alternates
             # with t, so consecutive tiles always double-buffer.
             def ixy(t):
@@ -212,8 +252,17 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
                     out_sems.at[slot],
                 )
 
+            def zp_dma(t, slot):
+                ix, iy = ixy(t)
+                return pltpu.make_async_copy(
+                    ZPin.at[pl.ds(sx_of(ix), SX), pl.ds(sy_of(iy), SY)],
+                    zpin.at[slot], zp_sems.at[slot],
+                )
+
             in_dma(0, 0).start()
             cp_dma(0, 0).start()
+            if zp:
+                zp_dma(0, 0).start()
 
             def tile(t, _):
                 slot = jax.lax.rem(t, 2)
@@ -229,9 +278,18 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
 
                     in_dma(t + 1, nslot).start()
                     cp_dma(t + 1, nslot).start()
+                    if zp:
+                        zp_dma(t + 1, nslot).start()
 
                 in_dma(t, slot).wait()
                 cp_dma(t, slot).wait()
+                if zp:
+                    zp_dma(t, slot).wait()
+                    # Apply the z-exchange patch in VMEM (see the leapfrog
+                    # kernel): lanes [0,k) -> planes [0,k), [k,2k) -> the
+                    # top k planes.
+                    tin[slot, :, :, 0:k] = zpin[slot, :, :, 0:k]
+                    tin[slot, :, :, n2 - k : n2] = zpin[slot, :, :, k : 2 * k]
                 minv = make_minv(cpin[slot])
                 # k-step ping-pong: tin[slot] -> scratch -> tin[slot] ...
                 # k is even, so the final state lands back in tin[slot].
@@ -249,8 +307,7 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
             out_dma(ntiles - 2, (ntiles - 2) % 2).wait()
             out_dma(ntiles - 1, (ntiles - 1) % 2).wait()
 
-        pl.run_scoped(
-            body,
+        scopes = dict(
             tin=pltpu.VMEM((2, SX, SY, n2), dt_),
             cpin=pltpu.VMEM((2, SX, SY, n2), dt_),
             scratch=pltpu.VMEM((SX, SY, n2), dt_),
@@ -258,18 +315,21 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by):
             cp_sems=pltpu.SemaphoreType.DMA((2,)),
             out_sems=pltpu.SemaphoreType.DMA((2,)),
         )
+        if zp:
+            scopes.update(
+                zpin=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zp_sems=pltpu.SemaphoreType.DMA((2,)),
+            )
+        pl.run_scoped(body, **scopes)
 
     # 5 VMEM tiles (2 T slots, 2 Cp slots, 1 scratch) + Mosaic's own margin;
     # the default 16 MiB scoped-vmem budget rejects tiles past ~16x32, so
     # request what the kernel actually needs (v5e has 128 MiB VMEM).
-    vmem_bytes = 5 * SX * SY * n2 * dt_.itemsize
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
     call = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((n0, n1, n2), dt_),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (3 if zp else 2),
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=min(110 * 1024 * 1024, 2 * vmem_bytes + 16 * 1024 * 1024)
