@@ -125,7 +125,17 @@ def _time_steps(step, state, chunk: int, reps: int):
     rtt_bound = 1.0
     lo = max((b2_min - rtt_bound) / (2 * K * chunk), 1e-9)
     t_it = min(max(t_it, lo), b2_min / (2 * K * chunk))
-    return t_it, state
+    # Per-rep spread (VERDICT r3 #7): the raw per-rep differences, pre-clamp,
+    # so cross-round drift on the time-shared chip is interpretable from the
+    # artifact alone (a tight spread + a >5% cross-round shift = real change;
+    # a wide spread = tenancy noise).
+    spread = {
+        "reps": reps,
+        "t_it_ms_min": round(diffs[0] * 1e3, 4),
+        "t_it_ms_med": round(diffs[len(diffs) // 2] * 1e3, 4),
+        "t_it_ms_max": round(diffs[-1] * 1e3, 4),
+    }
+    return t_it, state, spread
 
 
 def _fused_provenance(fused_k, support_error, local_shape, itemsize, fused_tile):
@@ -194,11 +204,11 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
     )
-    t_it, state = _time_steps(step, state, chunk, reps)
+    t_it, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     nbytes = 2 * n**3 * jax.numpy.dtype(dtype).itemsize
-    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs}
+    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs, "spread": spread}
     if fpath:
         extra["path"] = fpath
     return _emit(
@@ -243,11 +253,11 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
     )
-    t_it, state = _time_steps(step, state, chunk, reps)
+    t_it, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize  # P,Vx,Vy,Vz in+out
-    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs}
+    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs, "spread": spread}
     if fpath:
         extra["path"] = fpath
     return _emit(
@@ -293,13 +303,14 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
     )
-    t_step, state = _time_steps(step, state, chunk, reps)
+    t_step, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
     # Per PT iteration: qDx,qDy,qDz,Pf in+out = 8 array passes.
     t_pt = t_step / npt
     nbytes = 8 * n**3 * jax.numpy.dtype(dtype).itemsize
-    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs, "t_pt_ms": round(t_pt * 1e3, 4)}
+    extra = {"dims": list(gg.dims), "nprocs": gg.nprocs,
+             "t_pt_ms": round(t_pt * 1e3, 4), "spread": spread}
     if fpath:
         extra["path"] = fpath
     return _emit(

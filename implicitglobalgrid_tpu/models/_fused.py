@@ -1,14 +1,48 @@
-"""Shared fused-kernel fallback warning (diffusion + acoustic + porous).
+"""Shared fused-kernel helpers (diffusion + acoustic + porous).
 
-The reference's precedent is runtime path selection by threshold
-(`/root/reference/src/update_halo.jl:755-784`); here the selection happens at
-trace time against the kernel envelope (`fused_support_error`), warning once
-per (shape, k, reason) so production loops are not spammed.
+Fallback warning: the reference's precedent is runtime path selection by
+threshold (`/root/reference/src/update_halo.jl:755-784`); here the selection
+happens at trace time against the kernel envelope (`fused_support_error`),
+warning once per (shape, k, reason) so production loops are not spammed.
+
+Autodiff: `fused_with_xla_grad` makes ``jax.grad`` work through the fused
+Pallas chunks (which have no VJP of their own) by differentiating the
+equivalent XLA cadence in the backward pass.
 """
 
 from __future__ import annotations
 
 _warned: set = set()
+
+
+def fused_with_xla_grad(fused_body, xla_body):
+    """Make a fused Pallas chunk differentiable via its XLA-cadence twin.
+
+    The temporally-blocked Pallas kernels have no VJP; their XLA cadences
+    (same steps, same slab exchanges, pure jnp/lax ops) match them to a few
+    float ULPs — so the primal runs ``fused_body`` (full kernel speed) and
+    the backward pass recomputes + differentiates ``xla_body`` via
+    ``jax.vjp``.  Residuals are just the chunk inputs (rematerialization:
+    one extra XLA-cadence forward per backward, nothing saved across the
+    k-step loop).  Without this wrapper ``jax.grad`` over a fused multi-step
+    dies inside `pallas_call` with no actionable message; with it the fused
+    production path and the autodiff story (`tests/test_autodiff.py`)
+    compose.  TPU-first capability — no reference analogue (the reference
+    has no autodiff, SURVEY.md §0).
+    """
+    import jax
+
+    f = jax.custom_vjp(fused_body)
+
+    def fwd(*args):
+        return fused_body(*args), args
+
+    def bwd(args, g):
+        _, vjp = jax.vjp(xla_body, *args)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 def warn_fused_fallback(shape, k, err, model: str = "diffusion") -> None:

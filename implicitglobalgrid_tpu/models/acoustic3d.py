@@ -267,8 +267,12 @@ def make_multi_step(
 
         z_active = dim_has_halo_activity(gg, 2)
 
+        from ._fused import fused_with_xla_grad
+
         def fused_or_fallback(P, Vx, Vy, Vz, fused_body, xla_body,
                               zpatch_body=None):
+            # Kernel paths wrapped with `fused_with_xla_grad`: primal runs
+            # the Pallas chunk, jax.grad differentiates the XLA cadence.
             shape = tuple(P.shape)
             if (
                 zpatch_body is not None
@@ -280,10 +284,10 @@ def make_multi_step(
                 # The in-kernel z-slab application: avoids the whole-array
                 # relayouts a z-dim DUS costs at the kernel boundary (the
                 # exchanged-dimension anisotropy, docs/performance.md).
-                return zpatch_body(P, Vx, Vy, Vz)
+                return fused_with_xla_grad(zpatch_body, xla_body)(P, Vx, Vy, Vz)
             err = fused_support_error(shape, fused_k, P.dtype.itemsize, bx, by)
             if err is None:
-                return fused_body(P, Vx, Vy, Vz)
+                return fused_with_xla_grad(fused_body, xla_body)(P, Vx, Vy, Vz)
             warn_fused_fallback(shape, fused_k, err, model="acoustic")
             return xla_body(P, Vx, Vy, Vz)
 

@@ -276,7 +276,12 @@ def make_multi_step(
         # (w steps per width-w slab exchange — the deep halo is already
         # validated above), the reference's runtime-path-selection move
         # (`/root/reference/src/update_halo.jl:755-784`).
+        from ._fused import fused_with_xla_grad
+
         def fused_or_fallback(T, Cp, fused_body, xla_body, zpatch_body=None):
+            # Kernel paths are wrapped with `fused_with_xla_grad`: the
+            # primal runs the Pallas chunk, jax.grad differentiates the
+            # XLA-cadence twin (the kernels have no VJP).
             shape = tuple(T.shape)
             if (
                 zpatch_body is not None
@@ -287,10 +292,10 @@ def make_multi_step(
             ):
                 # In-kernel z-slab application (docs/performance.md's
                 # exchanged-dimension anisotropy note).
-                return zpatch_body(T, Cp)
+                return fused_with_xla_grad(zpatch_body, xla_body)(T, Cp)
             err = fused_support_error(shape, fused_k, T.dtype.itemsize, bx, by)
             if err is None:
-                return fused_body(T, Cp)
+                return fused_with_xla_grad(fused_body, xla_body)(T, Cp)
             _warn_fused_fallback(tuple(T.shape), fused_k, err)
             return xla_body(T, Cp)
 

@@ -498,11 +498,14 @@ def make_multi_step(
 
         xla_block_step = cadence_block_step(w)
         z_active = dim_has_halo_activity(gg, 2)
+        from ._fused import fused_with_xla_grad
 
         def block_step(T, Pf, qDx, qDy, qDz):
             # Shapes are only known at trace time, so the kernel-vs-fallback
             # choice happens there (the reference's runtime-path-selection
-            # move, `/root/reference/src/update_halo.jl:755-784`).
+            # move, `/root/reference/src/update_halo.jl:755-784`).  Kernel
+            # paths are wrapped with `fused_with_xla_grad`: primal runs the
+            # Pallas chunk, jax.grad differentiates the XLA cadence.
             shape = tuple(Pf.shape)
             if (
                 active
@@ -512,10 +515,14 @@ def make_multi_step(
                 ) is None
             ):
                 # In-kernel z-slab application (see docs/performance.md).
-                return fused_zpatch_step(T, Pf, qDx, qDy, qDz)
+                return fused_with_xla_grad(fused_zpatch_step, xla_block_step)(
+                    T, Pf, qDx, qDy, qDz
+                )
             err = fused_support_error(shape, w, Pf.dtype.itemsize, bx, by)
             if err is None:
-                return fused_block_step(T, Pf, qDx, qDy, qDz)
+                return fused_with_xla_grad(fused_block_step, xla_block_step)(
+                    T, Pf, qDx, qDy, qDz
+                )
             warn_fused_fallback(tuple(Pf.shape), w, err, model="porous")
             return xla_block_step(T, Pf, qDx, qDy, qDz)
 

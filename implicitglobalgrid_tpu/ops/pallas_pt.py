@@ -46,7 +46,7 @@ from .pallas_leapfrog import (  # noqa: F401  (re-export)
     z_patch_shapes,
 )
 
-_TILE_CANDIDATES = ((32, 64), (16, 32), (8, 16))
+_TILE_CANDIDATES = ((32, 64), (16, 64), (32, 32), (16, 32), (8, 16))
 
 #: See `ops.pallas_leapfrog._VMEM_BUDGET_BYTES` (Mosaic's scoped stack runs
 #: ~18% past the buffer-byte estimate on the staggered sets).
@@ -442,7 +442,7 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (9 if zp else 5),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=min(110 * 1024 * 1024, vmem_bytes + 16 * 1024 * 1024)
+            vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)
         ),
     )
     return jax.jit(call)

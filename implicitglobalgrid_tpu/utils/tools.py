@@ -107,10 +107,27 @@ def _coord_g(i, d, A, dim: int, coords):
     x = (c * (n - o) + i) * d + x0
     if gg.periods[dim]:
         # The first cell of the periodic global problem is a ghost cell: shift
-        # by one spacing and wrap (reference: src/tools.jl:101-105).
+        # by one spacing and wrap (reference: src/tools.jl:101-105).  The
+        # wrap CONDITIONS are evaluated in exact integer index space — the
+        # reference's float comparisons are seam-fragile in two opposite
+        # ways (observed in f64 with d = 10/123): the upper test can
+        # false-fire on the last in-range plane (fl(124*d - d) > fl(123*d)),
+        # and when it fires legitimately its subtraction can cancel to a
+        # tiny negative residue (125*d - d - 124*d ~ -2e-15) that a
+        # sequential lower wrap re-wraps — either way one seam plane lands a
+        # full period out of the domain, making the periodic IC inconsistent
+        # and breaking the plane-pair invariant the halo exchange is built
+        # on.  j2 is the doubled half-spacing index: x/d == j2/2 exactly
+        # (the 0.5*(n-size_d) staggering offset is a half-integer), so the
+        # integer comparisons decide the wrap exactly; the wrapped VALUES
+        # keep the reference's float formula.
         x = x - d
-        x = xp.where(x > (n_g - 1) * d, x - n_g * d, x)
-        x = xp.where(x < 0, x + n_g * d, x)
+        j2 = 2 * (c * (n - o) + i) + (n - size_d) - 2
+        x = xp.where(
+            j2 > 2 * (n_g - 1),
+            x - n_g * d,
+            xp.where(j2 < 0, x + n_g * d, x),
+        )
     if not traced and x.ndim == 0:
         return float(x)
     return x

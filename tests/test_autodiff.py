@@ -89,3 +89,85 @@ def test_grad_through_staggered_multi_step():
 
     _fd_check(loss, (P,), 0, [(4, 4, 4), (8, 8, 8), (0, 5, 5)])
     igg.finalize_global_grid()
+
+
+def test_grad_through_fused_diffusion_multi_step():
+    """jax.grad through `make_multi_step(fused_k=...)` (VERDICT r3 #8): the
+    Pallas chunk has no VJP, so `fused_with_xla_grad` runs the kernel in the
+    primal and differentiates the XLA-cadence twin in the backward pass —
+    the gradient must match the XLA cadence's gradient to float rounding."""
+    from jax.experimental.pallas import tpu as pltpu
+    from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
+
+    nloc = (16, 32, 128)
+    # f32: the kernel envelope rejects f64, which would silently test the
+    # fallback path instead of the custom-VJP wrapper.
+    assert fused_support_error(nloc, 2, 4, 8, 16, zpatch=True) is None
+    kw = dict(
+        devices=jax.devices()[:1], periodz=1, overlapz=4, quiet=True,
+        dtype=jnp.float32,
+    )
+    state, params = diffusion3d.setup(*nloc, **kw)
+    T, Cp = state
+
+    with pltpu.force_tpu_interpret_mode():
+        fused = diffusion3d.make_multi_step(
+            params, 2, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+
+        def loss_fused(T, Cp):
+            T2, _ = fused(T, Cp)
+            return jnp.sum(T2**2) * 1e-6
+
+        g_fused = jax.block_until_ready(jax.grad(loss_fused, argnums=(0, 1))(T, Cp))
+
+    cadence = diffusion3d.make_multi_step(params, 2, donate=False, exchange_every=2)
+
+    def loss_cad(T, Cp):
+        T2, _ = cadence(T, Cp)
+        return jnp.sum(T2**2) * 1e-6
+
+    g_cad = jax.block_until_ready(jax.grad(loss_cad, argnums=(0, 1))(T, Cp))
+    igg.finalize_global_grid()
+    for name, gf, gc in zip(("dT", "dCp"), g_fused, g_cad):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gc), rtol=1e-4, atol=1e-4, err_msg=name
+        )
+
+
+def test_grad_through_fused_staggered_multi_step():
+    """Same custom-VJP story for a staggered fused chunk (acoustic)."""
+    from jax.experimental.pallas import tpu as pltpu
+    from implicitglobalgrid_tpu.ops.pallas_leapfrog import fused_support_error
+
+    nloc = (16, 32, 128)
+    assert fused_support_error(nloc, 2, 4, 8, 16, zpatch=True) is None
+    kw = dict(
+        devices=jax.devices()[:1], periodz=1, overlapz=4, quiet=True,
+        dtype=jnp.float32,
+    )
+    state, params = acoustic3d.setup(*nloc, **kw)
+    P, Vx, Vy, Vz = state
+
+    with pltpu.force_tpu_interpret_mode():
+        fused = acoustic3d.make_multi_step(
+            params, 2, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+
+        def loss_fused(P):
+            out = fused(P, Vx, Vy, Vz)
+            return jnp.sum(out[0] ** 2)
+
+        g_fused = jax.block_until_ready(jax.grad(loss_fused)(P))
+
+    cadence = acoustic3d.make_multi_step(params, 2, donate=False, exchange_every=2)
+
+    def loss_cad(P):
+        out = cadence(P, Vx, Vy, Vz)
+        return jnp.sum(out[0] ** 2)
+
+    g_cad = jax.block_until_ready(jax.grad(loss_cad)(P))
+    igg.finalize_global_grid()
+    np.testing.assert_allclose(
+        np.asarray(g_fused), np.asarray(g_cad), rtol=1e-4, atol=1e-4
+    )

@@ -88,6 +88,26 @@ def test_xg_simulated_3x3x3():
     assert xs(igg.z_g, 7, dz, A, (0, 0, 2)) == [8.0, 10.0, 12.0, 14.0, 16.0, 0.0, 2.0]
 
 
+def test_zg_periodic_seam_no_double_wrap():
+    """f64 seam regression (round-3 diffusion z-patch failure's root cause):
+    the upper periodic wrap's float cancellation residue (e.g. 125*d - d -
+    124*d ~ -2e-15) must not trigger the lower wrap — that landed the seam
+    plane a full period outside the domain and broke the wrap invariant
+    (plane i == plane i+(n-o)) the halo exchange is built on."""
+    import jax
+
+    igg.init_global_grid(
+        16, 32, 128, periodz=1, overlapz=4, quiet=True, devices=[jax.devices()[0]]
+    )
+    lz = 10.0
+    dz = lz / (igg.nz_g() - 1)  # 10/123: non-terminating binary, residue case
+    A = np.zeros((16, 32, 128))
+    z = np.asarray([igg.z_g(i, dz, A) for i in range(128)])
+    o = 4
+    np.testing.assert_allclose(z[:o], z[128 - o :], rtol=0, atol=1e-12)
+    assert (z >= -1e-12).all() and (z <= lz + 1e-12).all()
+
+
 def test_xg_vectorized():
     igg.init_global_grid(5, 5, 5, quiet=True, devices=[__import__("jax").devices()[0]])
     A = np.zeros((5, 5, 5))
