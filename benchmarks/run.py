@@ -171,7 +171,7 @@ def _emit(name, teff, t_it, extra=None, emit=True):
 
 def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
                     devices=None, emit=True, fused_k=None, fused_tile=None,
-                    exchange_every=1, overlap=None, force_spmd=False):
+                    exchange_every=1, overlap=None, force_spmd=False, period=None):
     """Benchmarks run with ``donate=False``: buffer donation costs ~3x on the
     tunneled single-chip backend used for the round measurements (measured:
     375 -> 119 GB/s at 256^3 f32; identical HLO, runtime-side penalty), and
@@ -190,6 +190,8 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
     okw = {} if overlap is None else dict(
         overlapx=overlap, overlapy=overlap, overlapz=overlap
     )
+    for ax in period or "":
+        okw[f"period{ax}"] = 1
     state, params = diffusion3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices, force_spmd=force_spmd, **okw,
@@ -213,6 +215,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         extra["path"] = fpath
     return _emit(
         f"diffusion3d_{n}_{dtype}"
+        + (f"_period{period}" if period else "")
         + ("_overlap" if hide_comm else "")
         + fsuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
@@ -225,7 +228,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
 
 def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None,
                    emit=True, exchange_every=1, overlap=None, fused_k=None,
-                   fused_tile=None):
+                   fused_tile=None, period=None):
     """``fused_k``: the temporally-blocked staggered Pallas kernel
     (`ops/pallas_leapfrog.py`, k leapfrog steps per HBM pass) — needs
     ``n % 128 == 0`` in the minor dimension (use ``--n 256``)."""
@@ -239,6 +242,8 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
     okw = {} if overlap is None else dict(
         overlapx=overlap, overlapy=overlap, overlapz=overlap
     )
+    for ax in period or "":
+        okw[f"period{ax}"] = 1
     state, params = acoustic3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices, **okw,
@@ -262,6 +267,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         extra["path"] = fpath
     return _emit(
         f"acoustic3d_{n}_{dtype}"
+        + (f"_period{period}" if period else "")
         + ("_overlap" if hide_comm else "")
         + fsuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
@@ -274,7 +280,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
 
 def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
                  emit=True, exchange_every=1, overlap=None, fused_k=None,
-                 fused_tile=None):
+                 fused_tile=None, period=None):
     """``chunk`` whole time steps (= ``chunk*npt`` PT iterations) per call via
     `porous_convection3d.make_multi_step` — one XLA program, like the other
     models' production paths.  ``fused_k``: the temporally-blocked PT kernel
@@ -289,6 +295,8 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     okw = {} if overlap is None else dict(
         overlapx=overlap, overlapy=overlap, overlapz=overlap
     )
+    for ax in period or "":
+        okw[f"period{ax}"] = 1
     state, params = pc.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), npt=npt, quiet=True, devices=devices,
         **okw,
@@ -315,6 +323,7 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
         extra["path"] = fpath
     return _emit(
         f"porous_convection3d_{n}_{dtype}_npt{npt}"
+        + (f"_period{period}" if period else "")
         + fsuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_pt / 1e9,
@@ -398,6 +407,9 @@ def main():
     p.add_argument("--overlap", type=int, default=None,
                    help="grid overlap in every dimension (deep halos for "
                         "--fused-k/--exchange-every on communicating grids)")
+    p.add_argument("--period", default=None,
+                   help="periodic dimensions, e.g. 'z' or 'xz' (the 1-chip "
+                        "self-neighbor configs that exercise real exchanges)")
     p.add_argument("--weak-model", default="diffusion",
                    choices=["diffusion", "porous"],
                    help="model for the weak-scaling config (BASELINE config 4 "
@@ -406,27 +418,23 @@ def main():
     kw = dict(chunk=a.chunk, reps=a.reps, dtype=a.dtype)
     if a.what in ("diffusion", "all"):
         bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k,
-                        exchange_every=a.exchange_every, overlap=a.overlap, **kw)
+                        exchange_every=a.exchange_every, overlap=a.overlap,
+                        period=a.period, **kw)
     if a.what in ("acoustic", "all"):
         bench_acoustic(n=a.n or (256 if a.fused_k else 192), hide_comm=a.hide_comm,
                        fused_k=a.fused_k, exchange_every=a.exchange_every,
-                       overlap=a.overlap, **kw)
+                       overlap=a.overlap, period=a.period, **kw)
     if a.what in ("porous", "all"):
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly
         porous_chunk = a.chunk if a.what == "porous" else 4
+        # npt need not divide fused_k anymore: the ragged PT schedule
+        # (round 4) chunks any npt into even kernel chunks.
         npt = a.npt
-        if a.fused_k and npt % a.fused_k != 0:
-            # The PT cadence requires npt % w == 0 (make_multi_step raises);
-            # round npt up so `all --fused-k K` keeps running the porous and
-            # weak-scaling configs.
-            npt = ((npt + a.fused_k - 1) // a.fused_k) * a.fused_k
-            print(json.dumps({"note": f"porous npt {a.npt} -> {npt} "
-                              f"(must be a multiple of fused_k={a.fused_k})"}),
-                  flush=True)
         bench_porous(n=a.n or (256 if a.fused_k else 128), chunk=porous_chunk,
                      reps=a.reps, npt=npt, dtype=a.dtype, fused_k=a.fused_k,
-                     exchange_every=a.exchange_every, overlap=a.overlap)
+                     exchange_every=a.exchange_every, overlap=a.overlap,
+                     period=a.period)
     if a.what in ("weak", "all"):
         bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,
                            dtype=a.dtype, hide_comm=a.hide_comm,

@@ -15,6 +15,32 @@ from __future__ import annotations
 _warned: set = set()
 
 
+def run_group_schedule(chunks, body, carry, *, unroll_limit=8):
+    """Run ``carry = body(ki, carry)`` for each ``ki`` in ``chunks``.
+
+    The one loop shape behind every fused cadence's group sequence: a
+    leading run of equal chunks longer than ``unroll_limit`` goes through
+    ONE `lax.fori_loop` (bounds compile size for long schedules); the rest
+    is Python-unrolled — one Pallas call per group is tiny HLO, and the
+    unrolled form measured ~15-30% faster than a fori_loop over groups
+    (XLA pipelines DMAs across group boundaries; probed on v5e: porous
+    npt=12 fused6 788 -> 1017 GB/s/PT-iter, acoustic 256^3 fused6
+    1117 -> 1564).
+    """
+    prefix = 0
+    while prefix < len(chunks) and chunks[prefix] == chunks[0]:
+        prefix += 1
+    if prefix > unroll_limit:
+        from jax import lax
+
+        k0 = chunks[0]
+        carry = lax.fori_loop(0, prefix, lambda i, c: body(k0, c), carry)
+        chunks = chunks[prefix:]
+    for ki in chunks:
+        carry = body(ki, carry)
+    return carry
+
+
 def fused_with_xla_grad(fused_body, xla_body):
     """Make a fused Pallas chunk differentiable via its XLA-cadence twin.
 

@@ -254,10 +254,10 @@ def make_multi_step(
         if (bx is None) != (by is None):
             raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
 
-        def kernel_steps(P, Vxp, Vyp, Vzp, z_patches=None):
+        def kernel_steps(P, Vxp, Vyp, Vzp, z_patches=None, **zkw):
             return fused_leapfrog_steps(
                 P, Vxp, Vyp, Vzp, fused_k, cax, cay, caz, b, idx, idy, idz,
-                bx=bx, by=by, z_patches=z_patches,
+                bx=bx, by=by, z_patches=z_patches, **zkw,
             )
 
         def xla_step(s):
@@ -266,8 +266,9 @@ def make_multi_step(
             return p_update(P, Vx, Vy, Vz), Vx, Vy, Vz
 
         z_active = dim_has_halo_activity(gg, 2)
+        from ._fused import fused_with_xla_grad, run_group_schedule
 
-        from ._fused import fused_with_xla_grad
+        groups = [fused_k] * (nsteps // fused_k)
 
         def fused_or_fallback(P, Vx, Vy, Vz, fused_body, xla_body,
                               zpatch_body=None):
@@ -296,13 +297,9 @@ def make_multi_step(
             def fused_chunk(P, Vx, Vy, Vz):
                 # Pad once per chunk; the kernel keeps the padded layout
                 # across all groups (no exchange to serve).
-                padded = pad_faces(Vx, Vy, Vz)
-
-                def body(i, s):
-                    return kernel_steps(*s)
-
-                P, Vxp, Vyp, Vzp = lax.fori_loop(
-                    0, nsteps // fused_k, body, (P, *padded)
+                P, Vxp, Vyp, Vzp = run_group_schedule(
+                    groups, lambda ki, s: kernel_steps(*s),
+                    (P, *pad_faces(Vx, Vy, Vz)),
                 )
                 return (P, *unpad_faces(Vxp, Vyp, Vzp))
 
@@ -321,7 +318,7 @@ def make_multi_step(
         def fused_block_step(P, Vx, Vy, Vz):
             from ..ops.halo import update_halo_padded_faces
 
-            def group(i, s):
+            def group(ki, s):
                 s = kernel_steps(*s)
                 # One all-field slab exchange licenses the next fused_k
                 # steps (see the exchange_every docstring for why P's slab
@@ -329,37 +326,47 @@ def make_multi_step(
                 # chunk pays ONE pad/unpad instead of one per group.
                 return update_halo_padded_faces(*s, width=fused_k)
 
-            P, Vxp, Vyp, Vzp = lax.fori_loop(
-                0, nsteps // fused_k, group, (P, *pad_faces(Vx, Vy, Vz))
+            P, Vxp, Vyp, Vzp = run_group_schedule(
+                groups, group, (P, *pad_faces(Vx, Vy, Vz))
             )
             return (P, *unpad_faces(Vxp, Vyp, Vzp))
 
         def fused_zpatch_step(P, Vx, Vy, Vz):
             from ..ops.halo import (
                 apply_z_patches,
+                fix_topface_z_exports,
                 identity_z_patches,
+                ol,
                 update_halo_padded_faces,
-                z_slab_patches,
+                z_patches_from_exports,
             )
 
             s0 = (P, *pad_faces(Vx, Vy, Vz))
+            o_z = ol(2, shape=tuple(P.shape), gg=gg)
             # Chunk entry has fresh halos, so the first group's z patches
             # re-write the planes already in place.
             patches0 = identity_z_patches(*s0, width=fused_k)
 
-            def group(i, carry):
+            def group(ki, carry):
                 s, patches = carry
-                # The kernel applies the z patches tile-by-tile in VMEM;
-                # x/y slabs exchange outside (major/second-minor DUS is
-                # cheap); the NEXT group's z patches are extracted after
-                # x/y (sequential-dimension corner semantics).
-                s = kernel_steps(*s, z_patches=patches)
+                # The kernel applies the z patches tile-by-tile in VMEM AND
+                # exports the next group's send slabs (round 4: extraction
+                # outside paid whole-array relayouts per group); x/y slabs
+                # exchange outside for the fields and the packed exports
+                # alike (sequential-dimension corner semantics), then the z
+                # communication runs on the packed arrays alone.
+                out = kernel_steps(
+                    *s, z_patches=patches, z_export=True, z_overlap=o_z
+                )
+                s, exports = out[:4], out[4:]
+                exports = fix_topface_z_exports(exports, *s, width=fused_k)
                 s = update_halo_padded_faces(*s, width=fused_k, dims=(0, 1))
-                return s, z_slab_patches(*s, width=fused_k)
+                patches = z_patches_from_exports(
+                    exports, tuple(s[0].shape), width=fused_k
+                )
+                return s, patches
 
-            s, patches = lax.fori_loop(
-                0, nsteps // fused_k, group, (s0, patches0)
-            )
+            s, patches = run_group_schedule(groups, group, (s0, patches0))
             # One whole-array application restores the chunk-boundary
             # fresh-halo invariant (amortized over the whole chunk).
             P, Vxp, Vyp, Vzp = apply_z_patches(*s, patches, width=fused_k)

@@ -299,13 +299,21 @@ def make_multi_step(
             _warn_fused_fallback(tuple(T.shape), fused_k, err)
             return xla_body(T, Cp)
 
+        from ._fused import run_group_schedule
+
+        groups = [fused_k] * (nsteps // fused_k)
+
         if not active:
 
             def fused_chunk(T, Cp):
-                def body(i, T):
-                    return fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
-
-                return lax.fori_loop(0, nsteps // fused_k, body, T), Cp
+                T = run_group_schedule(
+                    groups,
+                    lambda ki, T: fused_diffusion_steps(
+                        T, Cp, ki, cx, cy, cz, bx=bx, by=by
+                    ),
+                    T,
+                )
+                return T, Cp
 
             def xla_chunk(T, Cp):
                 # No halo activity: the exchange is a no-op, plain steps.
@@ -320,8 +328,8 @@ def make_multi_step(
             )
 
         def fused_block_step(T, Cp):
-            def body(i, T):
-                T = fused_diffusion_steps(T, Cp, fused_k, cx, cy, cz, bx=bx, by=by)
+            def body(ki, T):
+                T = fused_diffusion_steps(T, Cp, ki, cx, cy, cz, bx=bx, by=by)
                 # One slab exchange licenses the next fused_k steps: the
                 # kernel's k-deep contaminated rind is exactly the region
                 # the width-k exchange refreshes, and the sent planes
@@ -329,30 +337,37 @@ def make_multi_step(
                 # where k kernel steps are still exact.
                 return update_halo(T, width=fused_k)
 
-            return lax.fori_loop(0, nsteps // fused_k, body, T), Cp
+            return run_group_schedule(groups, body, T), Cp
 
         def fused_zpatch_step(T, Cp):
             from ..ops.halo import (
                 apply_z_patch,
                 exchange_dims,
                 identity_z_patch,
-                z_slab_patch,
+                ol,
+                z_patch_from_export,
             )
 
-            def group(i, carry):
+            o_z = ol(2, shape=tuple(T.shape), gg=gg)
+
+            def group(ki, carry):
                 T, patch = carry
-                # The kernel applies the z patch per tile in VMEM; x/y
-                # slabs exchange outside (cheap DUS); next patch extracted
-                # after x/y (corner semantics).
-                T = fused_diffusion_steps(
-                    T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch
+                # The kernel applies the z patch per tile in VMEM AND
+                # exports the next group's send slabs (round 4: extraction
+                # outside the kernel paid whole-array relayouts per group);
+                # x/y slabs exchange outside (cheap DUS) for both T and the
+                # packed export (corner semantics), then the z communication
+                # runs on the packed array alone.
+                T, zex = fused_diffusion_steps(
+                    T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch,
+                    z_export=True, z_overlap=o_z,
                 )
                 T = exchange_dims(T, (0, 1), width=fused_k)
-                return T, z_slab_patch(T, width=fused_k)
+                zex = exchange_dims(zex, (0, 1), width=fused_k)
+                return T, z_patch_from_export(zex, width=fused_k)
 
-            T, patch = lax.fori_loop(
-                0, nsteps // fused_k, group,
-                (T, identity_z_patch(T, width=fused_k)),
+            T, patch = run_group_schedule(
+                groups, group, (T, identity_z_patch(T, width=fused_k))
             )
             return apply_z_patch(T, patch, width=fused_k), Cp
 

@@ -278,11 +278,11 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
     # the reference's ``MPI_Cart_shift(dim, disp)`` neighbor table
     # (`/root/reference/src/init_global_grid.jl:89-92`), which its
     # `update_halo!` sends to (`/root/reference/src/update_halo.jl:713-735`).
-    # The ppermute pairs below realize exactly `GlobalGrid.neighbors`
-    # (`parallel/topology.py:neighbors_table`): send_lo goes to
-    # ``neighbors[0, d]`` (coordinate - disp), send_hi to ``neighbors[1, d]``.
-    partner_self = (disp % nd == 0) if periodic else (disp == 0)
-    if partner_self:
+    # The ppermute pairs (see `_permute_slabs`) realize exactly
+    # `GlobalGrid.neighbors` (`parallel/topology.py:neighbors_table`):
+    # send_lo goes to ``neighbors[0, d]`` (coordinate - disp), send_hi to
+    # ``neighbors[1, d]``.
+    if _partner_self(gg, d):
         # Every block is its own partner (periodic wrap disp%nd==0, the
         # reference's self-neighbor fast path generalized, or disp==0):
         # pure local copy (reference: update_halo.jl:57-63).
@@ -291,12 +291,45 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
             _get_plane(A, o - width, d, width),  # -> planes [n-width, n)
         )
 
-    axis = AXIS_NAMES[d]
     # Slabs go to the lower partner's top ``width`` planes / the upper
     # partner's bottom ``width`` planes (reference sendranges/recvranges,
     # generalized from one plane to a slab).
-    send_lo = _get_plane(A, o - width, d, width)
-    send_hi = _get_plane(A, n - o, d, width)
+    return _permute_slabs(
+        gg, d,
+        send_lo=_get_plane(A, o - width, d, width),
+        send_hi=_get_plane(A, n - o, d, width),
+        keep_lo=lambda: _get_plane(A, 0, d, width),
+        keep_hi=lambda: _get_plane(A, n - width, d, width),
+    )
+
+
+def _partner_self(gg, d: int) -> bool:
+    """Every block its own distance-``disp`` partner along ``d``?"""
+    nd = gg.dims[d]
+    disp = int(gg.disp)
+    return (disp % nd == 0) if bool(gg.periods[d]) else (disp == 0)
+
+
+def _permute_slabs(gg, d: int, *, send_lo, send_hi, keep_lo, keep_hi):
+    """ppermute two send slabs to the distance-``disp`` partners along ``d``.
+
+    The ONE implementation of the neighbor communication used by both the
+    full-field exchange (`_slab_recv_values`) and the packed z-export path
+    (`z_patch_from_export`) — partner permutation, periodic wrap, and
+    PROC_NULL keep-old masking must never drift between the two.  Returns
+    ``(lo_vals, hi_vals)`` destined for planes ``[0,w)`` / ``[n-w,n)``;
+    ``keep_lo``/``keep_hi`` are thunks producing the current boundary slabs
+    for blocks whose shift falls off a non-periodic grid (the reference's
+    PROC_NULL neighbors do nothing).  Self-partner configs never reach
+    here (both callers take their own fast path).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    nd = gg.dims[d]
+    periodic = bool(gg.periods[d])
+    disp = int(gg.disp)
+    axis = AXIS_NAMES[d]
     if periodic:
         perm_down = [(i, (i - disp) % nd) for i in range(nd)]
         perm_up = [(i, (i + disp) % nd) for i in range(nd)]
@@ -315,15 +348,14 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
         ) from e
     if periodic:
         return recv_lo, recv_hi
-    # Blocks whose shift falls off the grid have no source: ppermute
-    # delivered zeros there; keep the old boundary slab (the reference's
-    # PROC_NULL neighbors do nothing).
+    # ppermute delivered zeros to blocks with no source partner; keep the
+    # old boundary slab there.
     idx = lax.axis_index(axis)
     has_upper = (idx + disp >= 0) & (idx + disp < nd)
     has_lower = (idx - disp >= 0) & (idx - disp < nd)
     return (
-        jnp.where(has_lower, recv_lo, _get_plane(A, 0, d, width)),
-        jnp.where(has_upper, recv_hi, _get_plane(A, n - width, d, width)),
+        jnp.where(has_lower, recv_lo, keep_lo()),
+        jnp.where(has_upper, recv_hi, keep_hi()),
     )
 
 
@@ -388,14 +420,101 @@ def apply_z_patch(A, patch, *, width: int = 1):
     return _set_plane(A, patch[:, :, width : 2 * width], n - width, 2)
 
 
-def exchange_dims(A, dims, *, width: int = 1):
+def exchange_dims(A, dims, *, width: int = 1, logical=None):
     """Exchange a single field along the given dimensions only (traced
     context; the z-patch cadences exchange x/y here and route z through
-    the kernel)."""
+    the kernel).  ``logical`` as in `_exchange_dim` (packed z-slab exports
+    exchange with their field's REAL x/y slab indices)."""
     gg = _grid.global_grid()
     for d in dims:
-        A = _exchange_dim(A, d, gg, width)
+        A = _exchange_dim(A, d, gg, width, logical=logical)
     return A
+
+
+def z_patch_from_export(export, *, width: int):
+    """The next group's packed z patch from a fused kernel's z-slab export.
+
+    Export lane layout (see `ops.pallas_stencil.fused_diffusion_steps`
+    ``z_export``): ``[0,w)`` = send-hi planes ``[n-o, n-o+w)``, ``[w,2w)``
+    = send-lo planes ``[o-w, o)``, ``[2w,3w)``/``[3w,4w)`` = the current
+    boundary planes (PROC_NULL keep-old values).  This is the z-dimension
+    communication of `_slab_recv_values` performed on the packed 128-lane
+    array instead of the full field — the kernel already did the
+    extraction in VMEM, so no whole-array minor-dim relayout is paid.
+    Must run AFTER the x/y exchanges of the export (sequential-dimension
+    corner semantics ride the packed array).
+    """
+    import jax.numpy as jnp
+
+    gg = _grid.global_grid()
+    w = width
+    if _partner_self(gg, 2):
+        # Lanes [0,2w) are already the patch (send-hi -> planes [0,w),
+        # send-lo -> the top w planes) — the self-neighbor fast path.
+        return export
+    recv_lo, recv_hi = _permute_slabs(
+        gg, 2,
+        send_lo=export[:, :, w : 2 * w],
+        send_hi=export[:, :, 0:w],
+        keep_lo=lambda: export[:, :, 2 * w : 3 * w],
+        keep_hi=lambda: export[:, :, 3 * w : 4 * w],
+    )
+    packed = jnp.concatenate([recv_lo, recv_hi], axis=2)
+    return jnp.pad(packed, ((0, 0), (0, 0), (0, 128 - 2 * w)))
+
+
+def fix_topface_z_exports(exports, C, Axp, Ayp, Azp, *, width: int):
+    """Fill the frozen top-face slabs of the staggered kernels' z exports.
+
+    The Vx row ``n0`` and Vy column ``n1`` (each field's real top face)
+    sit outside every tile's owned block, so the kernels never write their
+    export rows — fill them here from the output arrays (a one-row minor
+    slice: ~n1*n2 elements, negligible next to the whole-array relayouts
+    the export replaces).  Must run BEFORE the exports' x/y exchange: on
+    x/y-active grids the exchange then overwrites the rows that belong to
+    neighbors, exactly as it does for the fields themselves.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    gg = _grid.global_grid()
+    n0, n1, n2 = C.shape
+    w = width
+    o = ol(2, shape=(n0, n1, n2), gg=gg)
+    exp_c, exp_x, exp_y, exp_z = exports
+
+    def packed_lanes(row):
+        return jnp.concatenate(
+            [
+                row[..., n2 - o : n2 - o + w],
+                row[..., o - w : o],
+                row[..., 0:w],
+                row[..., n2 - w : n2],
+            ],
+            axis=2,
+        )
+
+    exp_x = lax.dynamic_update_slice(
+        exp_x, packed_lanes(Axp[n0 : n0 + 1]), (n0, 0, 0)
+    )
+    exp_y = lax.dynamic_update_slice(
+        exp_y, packed_lanes(Ayp[:, n1 : n1 + 1]), (0, n1, 0)
+    )
+    return exp_c, exp_x, exp_y, exp_z
+
+
+def z_patches_from_exports(exports, C_shape, *, width: int):
+    """x/y-exchange the four packed z exports (real-shape slab indices via
+    ``logical``) and turn each into the next group's patch — the multi-field
+    z communication of the staggered z-slab cadence, all on packed arrays.
+    """
+    n0, n1, _ = C_shape
+    logicals = (None, (n0 + 1, n1, 128), (n0, n1 + 1, 128), None)
+    out = []
+    for e, lg in zip(exports, logicals):
+        e = exchange_dims(e, (0, 1), width=width, logical=lg)
+        out.append(z_patch_from_export(e, width=width))
+    return tuple(out)
 
 
 def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1):

@@ -88,7 +88,8 @@ def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
     """VMEM bytes for one full ping-pong set (4 fields x (2 slots + scratch)).
 
     ``zpatch``: add the four double-buffered 128-lane z-patch windows (the
-    in-kernel z-exchange application, `z_slab_patches`)."""
+    in-kernel z-exchange application, `z_slab_patches`) and the z-export
+    staging slots."""
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     per_set = (
@@ -99,7 +100,7 @@ def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
     )
     total = 3 * per_set
     if zpatch:
-        total += 2 * 128 * (
+        total += 4 * 128 * (
             SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
         )
     return total * itemsize
@@ -208,7 +209,8 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
                          cax: float, cay: float, caz: float,
                          b: float, idx: float, idy: float, idz: float,
                          *, bx: int | None = None, by: int | None = None,
-                         z_patches=None):
+                         z_patches=None, z_export: bool = False,
+                         z_overlap: int | None = None):
     """Advance ``k`` (even) leapfrog steps in one HBM pass per field.
 
     ``P`` is the cell-centered pressure ``(n0, n1, n2)``; ``Vxp/Vyp/Vzp`` are
@@ -223,6 +225,20 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
     kernel boundary (see the exchanged-dimension anisotropy note in
     docs/performance.md).  Lanes ``[0, k)`` overwrite each field's z planes
     ``[0, k)``, lanes ``[k, 2k)`` its planes ``[n_z - k, n_z)``.
+
+    ``z_export`` (requires ``z_patches`` + the grid z-overlap ``z_overlap``):
+    additionally return the four packed z-slab exports (shapes
+    `z_patch_shapes`) for the NEXT group's patches — the extraction half of
+    the z-anisotropy fix (see `ops.pallas_stencil.fused_diffusion_steps`).
+    Lane layout per field f with logical z size ``n_f`` and overlap ``o_f``
+    (``o_f = o+1`` for Vz, shape-aware): ``[0,k)`` = planes
+    ``[n_f-o_f, n_f-o_f+k)``, ``[k,2k)`` = planes ``[o_f-k, o_f)``,
+    ``[2k,4k)`` = current boundary planes.  The Vx row ``n0`` / Vy column
+    ``n1`` (frozen top-face) slabs are NOT exported by the tiles (their
+    owned-block partition excludes them) — the model cadence fixes them up
+    from the output arrays (`ops.halo.fix_topface_z_exports`), and on
+    x/y-active grids the exports' own x/y slab exchange refreshes them
+    anyway.
     """
     n0, n1, n2 = P.shape
     if (Vxp.shape, Vyp.shape, Vzp.shape) != padded_face_shapes(P.shape):
@@ -241,6 +257,16 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
             )
         if any(a.dtype != P.dtype for a in z_patches):
             raise ValueError("z_patches must share the fields' dtype")
+    if z_export:
+        if not zp:
+            raise ValueError("z_export requires z_patches (the z-slab cadence)")
+        if z_overlap is None or not (2 * k <= z_overlap <= n2 // 2):
+            raise ValueError(
+                f"z_export needs the grid z-overlap with 2k <= o <= n2/2: "
+                f"got o={z_overlap}, k={k}, n2={n2}"
+            )
+        if 4 * k > 128:
+            raise ValueError(f"z_export packs 4k lanes; k={k} > 32 unsupported")
     err = fused_support_error((n0, n1, n2), k, P.dtype.itemsize, bx, by, zpatch=zp)
     if err is not None:
         raise ValueError(err)
@@ -249,7 +275,8 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
     fn = _build(n0, n1, n2, str(P.dtype), int(k),
                 float(cax), float(cay), float(caz),
                 float(b), float(idx), float(idy), float(idz),
-                int(bx), int(by), zp)
+                int(bx), int(by), zp,
+                bool(z_export), int(z_overlap) if z_export else 0)
     if zp:
         return fn(P, Vxp, Vyp, Vzp, *z_patches)
     return fn(P, Vxp, Vyp, Vzp)
@@ -257,7 +284,7 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
 
 @functools.lru_cache(maxsize=64)
 def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
-           zp: bool = False):
+           zp: bool = False, zx: bool = False, o: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -340,7 +367,11 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
         dp[:] = P - b * div
 
     def kernel(*refs):
-        if zp:
+        ZXp = ZXx = ZXy = ZXz = None
+        if zp and zx:
+            (Pin, Vxin, Vyin, Vzin, ZPp, ZPx, ZPy, ZPz,
+             Pout, Vxout, Vyout, Vzout, ZXp, ZXx, ZXy, ZXz) = refs
+        elif zp:
             (Pin, Vxin, Vyin, Vzin, ZPp, ZPx, ZPy, ZPz,
              Pout, Vxout, Vyout, Vzout) = refs
         else:
@@ -349,7 +380,8 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
 
         def body(p, vx, vy, vz, sp, svx, svy, svz,
                  p_is, vx_is, vy_is, vz_is, p_os, vx_os, vy_os, vz_os, fix_s,
-                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None):
+                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None,
+                 zxp=None, zxx=None, zxy=None, zxz=None, zx_os=None):
             def ixy(t):
                 return t // ncy, t % ncy
 
@@ -417,6 +449,30 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     ),
                 )
 
+            def zex_dmas(t, slot):
+                ix, iy = ixy(t)
+                ox = ix * bx - sx_of(ix)
+                oy = pl.multiple_of(iy * by - sy_of(iy), 8)
+                gx, gy = ix * bx, iy * by
+                return (
+                    pltpu.make_async_copy(
+                        zxp.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXp.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[0, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        zxx.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXx.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[1, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        zxy.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXy.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[2, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        zxz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXz.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[3, slot],
+                    ),
+                )
+
             def start_in(t, slot):
                 for d in in_dmas(t, slot):
                     d.start()
@@ -428,10 +484,16 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
             def start_out(t, slot):
                 for d in out_dmas(t, slot):
                     d.start()
+                if zx:
+                    for d in zex_dmas(t, slot):
+                        d.start()
 
             def wait_out(t, slot):
                 for d in out_dmas(t, slot):
                     d.wait()
+                if zx:
+                    for d in zex_dmas(t, slot):
+                        d.wait()
 
             # Top-slab fix-up: the frozen Vx row-n0 / Vy col-n1 face planes
             # (plus their 7 junk planes) are outside every tile's owned
@@ -493,6 +555,26 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                             sp, svx, svy, svz,
                             ring=False,
                         )
+                if zx:
+                    # z-slab export for the NEXT group's patches (VMEM
+                    # extraction — see the diffusion kernel).  Vz uses its
+                    # logical n_f = SZ+1, o_f = o+1 (staggered z face).
+                    zxp[slot, :, :, 0:k] = p[slot, :, :, SZ - o : SZ - o + k]
+                    zxp[slot, :, :, k : 2 * k] = p[slot, :, :, o - k : o]
+                    zxp[slot, :, :, 2 * k : 3 * k] = p[slot, :, :, 0:k]
+                    zxp[slot, :, :, 3 * k : 4 * k] = p[slot, :, :, SZ - k : SZ]
+                    zxx[slot, :, :, 0:k] = vx[slot, :, :, SZ - o : SZ - o + k]
+                    zxx[slot, :, :, k : 2 * k] = vx[slot, :, :, o - k : o]
+                    zxx[slot, :, :, 2 * k : 3 * k] = vx[slot, :, :, 0:k]
+                    zxx[slot, :, :, 3 * k : 4 * k] = vx[slot, :, :, SZ - k : SZ]
+                    zxy[slot, :, :, 0:k] = vy[slot, :, :, SZ - o : SZ - o + k]
+                    zxy[slot, :, :, k : 2 * k] = vy[slot, :, :, o - k : o]
+                    zxy[slot, :, :, 2 * k : 3 * k] = vy[slot, :, :, 0:k]
+                    zxy[slot, :, :, 3 * k : 4 * k] = vy[slot, :, :, SZ - k : SZ]
+                    zxz[slot, :, :, 0:k] = vz[slot, :, :, SZ - o : SZ - o + k]
+                    zxz[slot, :, :, k : 2 * k] = vz[slot, :, :, o + 1 - k : o + 1]
+                    zxz[slot, :, :, 2 * k : 3 * k] = vz[slot, :, :, 0:k]
+                    zxz[slot, :, :, 3 * k : 4 * k] = vz[slot, :, :, SZ + 1 - k : SZ + 1]
                 start_out(t, slot)
                 return 0
 
@@ -531,19 +613,32 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                 zpz=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zp_is=pltpu.SemaphoreType.DMA((4, 2)),
             )
+        if zx:
+            scopes.update(
+                zxp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zxx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
+                zxy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
+                zxz=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zx_os=pltpu.SemaphoreType.DMA((4, 2)),
+            )
         pl.run_scoped(body, **scopes)
 
     vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
+    out_shape = [
+        jax.ShapeDtypeStruct((n0, n1, n2), dt_),
+        jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),
+        jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
+        jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
+    ]
+    if zx:
+        out_shape += [
+            jax.ShapeDtypeStruct(s, dt_) for s in z_patch_shapes((n0, n1, n2))
+        ]
     call = pl.pallas_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((n0, n1, n2), dt_),
-            jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),
-            jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
-            jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
-        ),
+        out_shape=tuple(out_shape),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (8 if zp else 4),
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(out_shape),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)
         ),

@@ -55,7 +55,8 @@ _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
     """VMEM bytes: 4 ping-pong fields x (2 slots + scratch) + 2 T slots
-    (+ the double-buffered 128-lane z-patch windows when ``zpatch``)."""
+    (+ the double-buffered 128-lane z-patch windows and z-export staging
+    slots when ``zpatch``)."""
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     per_set = (
@@ -66,7 +67,7 @@ def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
     )
     total = 3 * per_set + 2 * SX * SY * n2
     if zpatch:
-        total += 2 * 128 * (
+        total += 4 * 128 * (
             SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
         )
     return total * itemsize
@@ -113,7 +114,9 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
                         th: float, idx: float, idy: float, idz: float,
                         ralam: float, bp: float,
                         *, bx: int | None = None, by: int | None = None,
-                        z_patches=None):
+                        z_patches=None, z_patch_width: int | None = None,
+                        z_export: bool = False, z_export_width: int | None = None,
+                        z_overlap: int | None = None):
     """Advance ``k`` (even) PT relaxation iterations in one HBM pass per field.
 
     ``T``/``Pf`` are cell-centered ``(n0, n1, n2)``; ``qxp/qyp/qzp`` are the
@@ -125,6 +128,19 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
     ``z_patches``: packed z-exchange patches for the four PT fields
     (`ops.halo.z_slab_patches`, width ``k``), applied per tile in VMEM —
     see `ops.pallas_leapfrog.fused_leapfrog_steps`.
+
+    ``z_export``/``z_overlap``: additionally return the four packed z-slab
+    exports for the NEXT group's patches — same lane layout, top-face
+    fix-up obligation, and rationale as the leapfrog kernel's ``z_export``
+    (`ops.pallas_leapfrog.fused_leapfrog_steps`).
+
+    ``z_patch_width``/``z_export_width`` (default ``k``): widths of the
+    patch application and the exported slabs — the ragged-``npt`` cadence
+    (`models.porous_convection3d`) keeps both at the schedule's maximum
+    chunk ``w`` for every chunk, so a shorter chunk (``k < w``) still heals
+    the previous chunk's ``w``-deep stale rind and exports ``w``-deep
+    slabs.  Requires ``k <= width`` and ``o >= z_export_width + k`` (the
+    exported planes must be exact after ``k`` steps).
     """
     n0, n1, n2 = Pf.shape
     if T.shape != Pf.shape:
@@ -145,6 +161,22 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
             )
         if any(a.dtype != Pf.dtype for a in z_patches):
             raise ValueError("z_patches must share the fields' dtype")
+    wp = k if z_patch_width is None else int(z_patch_width)
+    we = k if z_export_width is None else int(z_export_width)
+    if zp and not (k <= wp <= 64):
+        raise ValueError(f"z_patch_width must satisfy k <= wp <= 64: got {wp}, k={k}")
+    if z_export:
+        if not zp:
+            raise ValueError("z_export requires z_patches (the z-slab cadence)")
+        if z_overlap is None or not (we + k <= z_overlap <= n2 // 2):
+            raise ValueError(
+                f"z_export needs the grid z-overlap with we+k <= o <= n2/2: "
+                f"got o={z_overlap}, k={k}, we={we}, n2={n2}"
+            )
+        if 4 * we > 128:
+            raise ValueError(
+                f"z_export packs 4*we lanes; z_export_width={we} > 32 unsupported"
+            )
     err = fused_support_error((n0, n1, n2), k, Pf.dtype.itemsize, bx, by, zpatch=zp)
     if err is not None:
         raise ValueError(err)
@@ -152,7 +184,9 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
         bx, by = default_tile((n0, n1, n2), k, Pf.dtype.itemsize, zpatch=zp)
     fn = _build(n0, n1, n2, str(Pf.dtype), int(k),
                 float(th), float(idx), float(idy), float(idz),
-                float(ralam), float(bp), int(bx), int(by), zp)
+                float(ralam), float(bp), int(bx), int(by), zp,
+                bool(z_export), int(z_overlap) if z_export else 0,
+                wp if zp else 0, we if z_export else 0)
     if zp:
         return fn(T, Pf, qxp, qyp, qzp, *z_patches)
     return fn(T, Pf, qxp, qyp, qzp)
@@ -160,7 +194,8 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
 
 @functools.lru_cache(maxsize=64)
 def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
-           zp: bool = False):
+           zp: bool = False, zx: bool = False, o: int = 0,
+           wp: int = 0, we: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -242,7 +277,11 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
         dp[:] = P - bp * div
 
     def kernel(*refs):
-        if zp:
+        ZXp = ZXx = ZXy = ZXz = None
+        if zp and zx:
+            (Tin, Pfin, Qxin, Qyin, Qzin, ZPp, ZPx, ZPy, ZPz,
+             Pfout, Qxout, Qyout, Qzout, ZXp, ZXx, ZXy, ZXz) = refs
+        elif zp:
             (Tin, Pfin, Qxin, Qyin, Qzin, ZPp, ZPx, ZPy, ZPz,
              Pfout, Qxout, Qyout, Qzout) = refs
         else:
@@ -252,7 +291,8 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
         def body(t, p, qx, qy, qz, sp, sqx, sqy, sqz,
                  t_is, p_is, qx_is, qy_is, qz_is,
                  p_os, qx_os, qy_os, qz_os, fix_s,
-                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None):
+                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None,
+                 zxp=None, zxx=None, zxy=None, zxz=None, zx_os=None):
             def ixy(tt):
                 return tt // ncy, tt % ncy
 
@@ -321,6 +361,30 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                     ),
                 )
 
+            def zex_dmas(tt, slot):
+                ix, iy = ixy(tt)
+                ox = ix * bx - sx_of(ix)
+                oy = pl.multiple_of(iy * by - sy_of(iy), 8)
+                gx, gy = ix * bx, iy * by
+                return (
+                    pltpu.make_async_copy(
+                        zxp.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXp.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[0, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        zxx.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXx.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[1, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        zxy.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXy.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[2, slot],
+                    ),
+                    pltpu.make_async_copy(
+                        zxz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXz.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[3, slot],
+                    ),
+                )
+
             def start_in(tt, slot):
                 for d in in_dmas(tt, slot):
                     d.start()
@@ -332,10 +396,16 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
             def start_out(tt, slot):
                 for d in out_dmas(tt, slot):
                     d.start()
+                if zx:
+                    for d in zex_dmas(tt, slot):
+                        d.start()
 
             def wait_out(tt, slot):
                 for d in out_dmas(tt, slot):
                     d.wait()
+                if zx:
+                    for d in zex_dmas(tt, slot):
+                        d.wait()
 
             # Frozen top-slab fix-up (see the leapfrog kernel): Qx row-n0 and
             # Qy col-n1 planes; Qz's top face rides the full-minor out-DMAs.
@@ -366,16 +436,16 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                 wait_in(tt, slot)
                 if zp:
                     # Apply the z-exchange patches in VMEM (see the
-                    # leapfrog kernel): lanes [0,k) -> planes [0,k),
-                    # lanes [k,2k) -> the top k planes of each field.
-                    p[slot, :, :, 0:k] = zpp[slot, :, :, 0:k]
-                    p[slot, :, :, SZ - k : SZ] = zpp[slot, :, :, k : 2 * k]
-                    qx[slot, :, :, 0:k] = zpx[slot, :, :, 0:k]
-                    qx[slot, :, :, SZ - k : SZ] = zpx[slot, :, :, k : 2 * k]
-                    qy[slot, :, :, 0:k] = zpy[slot, :, :, 0:k]
-                    qy[slot, :, :, SZ - k : SZ] = zpy[slot, :, :, k : 2 * k]
-                    qz[slot, :, :, 0:k] = zpz[slot, :, :, 0:k]
-                    qz[slot, :, :, SZ + 1 - k : SZ + 1] = zpz[slot, :, :, k : 2 * k]
+                    # leapfrog kernel): lanes [0,wp) -> planes [0,wp),
+                    # lanes [wp,2wp) -> the top wp planes of each field.
+                    p[slot, :, :, 0:wp] = zpp[slot, :, :, 0:wp]
+                    p[slot, :, :, SZ - wp : SZ] = zpp[slot, :, :, wp : 2 * wp]
+                    qx[slot, :, :, 0:wp] = zpx[slot, :, :, 0:wp]
+                    qx[slot, :, :, SZ - wp : SZ] = zpx[slot, :, :, wp : 2 * wp]
+                    qy[slot, :, :, 0:wp] = zpy[slot, :, :, 0:wp]
+                    qy[slot, :, :, SZ - wp : SZ] = zpy[slot, :, :, wp : 2 * wp]
+                    qz[slot, :, :, 0:wp] = zpz[slot, :, :, 0:wp]
+                    qz[slot, :, :, SZ + 1 - wp : SZ + 1] = zpz[slot, :, :, wp : 2 * wp]
                 tv = t[slot]
                 for j in range(k):
                     if j % 2 == 0:
@@ -390,6 +460,26 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                             sp, sqx, sqy, sqz,
                             tv, ring=False,
                         )
+                if zx:
+                    # z-slab export for the NEXT group's patches (VMEM
+                    # extraction — see the leapfrog kernel).  Qz uses its
+                    # logical n_f = SZ+1, o_f = o+1 (staggered z face).
+                    zxp[slot, :, :, 0:we] = p[slot, :, :, SZ - o : SZ - o + we]
+                    zxp[slot, :, :, we : 2 * we] = p[slot, :, :, o - we : o]
+                    zxp[slot, :, :, 2 * we : 3 * we] = p[slot, :, :, 0:we]
+                    zxp[slot, :, :, 3 * we : 4 * we] = p[slot, :, :, SZ - we : SZ]
+                    zxx[slot, :, :, 0:we] = qx[slot, :, :, SZ - o : SZ - o + we]
+                    zxx[slot, :, :, we : 2 * we] = qx[slot, :, :, o - we : o]
+                    zxx[slot, :, :, 2 * we : 3 * we] = qx[slot, :, :, 0:we]
+                    zxx[slot, :, :, 3 * we : 4 * we] = qx[slot, :, :, SZ - we : SZ]
+                    zxy[slot, :, :, 0:we] = qy[slot, :, :, SZ - o : SZ - o + we]
+                    zxy[slot, :, :, we : 2 * we] = qy[slot, :, :, o - we : o]
+                    zxy[slot, :, :, 2 * we : 3 * we] = qy[slot, :, :, 0:we]
+                    zxy[slot, :, :, 3 * we : 4 * we] = qy[slot, :, :, SZ - we : SZ]
+                    zxz[slot, :, :, 0:we] = qz[slot, :, :, SZ - o : SZ - o + we]
+                    zxz[slot, :, :, we : 2 * we] = qz[slot, :, :, o + 1 - we : o + 1]
+                    zxz[slot, :, :, 2 * we : 3 * we] = qz[slot, :, :, 0:we]
+                    zxz[slot, :, :, 3 * we : 4 * we] = qz[slot, :, :, SZ + 1 - we : SZ + 1]
                 start_out(tt, slot)
                 return 0
 
@@ -428,19 +518,32 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                 zpz=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zp_is=pltpu.SemaphoreType.DMA((4, 2)),
             )
+        if zx:
+            scopes.update(
+                zxp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zxx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
+                zxy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
+                zxz=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zx_os=pltpu.SemaphoreType.DMA((4, 2)),
+            )
         pl.run_scoped(body, **scopes)
 
     vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
+    out_shape = [
+        jax.ShapeDtypeStruct((n0, n1, n2), dt_),
+        jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),
+        jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
+        jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
+    ]
+    if zx:
+        out_shape += [
+            jax.ShapeDtypeStruct(s, dt_) for s in z_patch_shapes((n0, n1, n2))
+        ]
     call = pl.pallas_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((n0, n1, n2), dt_),
-            jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),
-            jax.ShapeDtypeStruct((n0, n1 + 8, n2), dt_),
-            jax.ShapeDtypeStruct((n0, n1, n2 + 128), dt_),
-        ),
+        out_shape=tuple(out_shape),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (9 if zp else 5),
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(out_shape),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)
         ),

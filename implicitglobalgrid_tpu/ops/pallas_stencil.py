@@ -71,12 +71,13 @@ _VMEM_BUDGET_BYTES = 100 * 1024 * 1024
 
 def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
     """VMEM bytes for the 5-tile working set (2 T slots, 2 Cp slots, scratch)
-    plus the double-buffered 128-lane z-patch windows when ``zpatch``
-    (``Cp`` is frozen — only ``T`` carries patches)."""
+    plus, when ``zpatch``, the double-buffered 128-lane z-patch windows AND
+    the z-export staging slots (``Cp`` is frozen — only ``T`` carries
+    patches)."""
     H = _envelope.aligned_halo(k)
     total = 5 * (bx + 2 * k) * (by + 2 * H) * n2
     if zpatch:
-        total += 2 * (bx + 2 * k) * (by + 2 * H) * 128
+        total += 4 * (bx + 2 * k) * (by + 2 * H) * 128
     return total * itemsize
 
 
@@ -126,7 +127,8 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
 
 def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
                           *, bx: int | None = None, by: int | None = None,
-                          z_patch=None):
+                          z_patch=None, z_export: bool = False,
+                          z_overlap: int | None = None):
     """Advance ``k`` (even) diffusion steps in one HBM pass.
 
     ``cx = dt*lam/dx^2`` (likewise ``cy``, ``cz``); ``(bx, by)`` = output
@@ -134,10 +136,21 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
     a multiple of 8; the haloed tile must fit inside the array.  Defaults to
     the fastest valid `_TILE_CANDIDATES` entry for the volume.
 
-    ``z_patch``: packed z-exchange patch for ``T`` (`ops.halo.z_slab_patch`,
-    width ``k``, shape ``(n0, n1, 128)``) applied per tile in VMEM before
-    stepping — see `ops.pallas_leapfrog.fused_leapfrog_steps` (``Cp`` is
-    frozen; its halos never change, so it needs no patch).
+    ``z_patch``: packed z-exchange patch for ``T`` (`ops.halo.z_slab_patch`
+    layout, width ``k``, shape ``(n0, n1, 128)``) applied per tile in VMEM
+    before stepping — see `ops.pallas_leapfrog.fused_leapfrog_steps` (``Cp``
+    is frozen; its halos never change, so it needs no patch).
+
+    ``z_export`` (requires ``z_patch`` + the grid z-overlap ``z_overlap``):
+    additionally return the packed z-slab export for the NEXT group's patch
+    — lanes ``[0,k)`` = post-step planes ``[n2-o, n2-o+k)`` (send-hi),
+    ``[k,2k)`` = planes ``[o-k, o)`` (send-lo), ``[2k,3k)``/``[3k,4k)`` =
+    the current boundary planes ``[0,k)``/``[n2-k,n2)`` (PROC_NULL
+    keep-old values), junk beyond.  Extracting these in VMEM is free;
+    doing it outside the kernel costs whole-array relayouts per group
+    (minor-dim lane-unaligned slices — the z-anisotropy gap,
+    docs/performance.md).  `ops.halo.z_patch_from_export` turns the export
+    into the next patch.
     """
     n0, n1, n2 = T.shape
     if T.dtype != Cp.dtype:
@@ -150,20 +163,32 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
             )
         if z_patch.dtype != T.dtype:
             raise ValueError("z_patch must share T's dtype")
+    if z_export:
+        if not zp:
+            raise ValueError("z_export requires z_patch (the z-slab cadence)")
+        if z_overlap is None or not (2 * k <= z_overlap <= n2 // 2):
+            raise ValueError(
+                f"z_export needs the grid z-overlap with 2k <= o <= n2/2: "
+                f"got o={z_overlap}, k={k}, n2={n2}"
+            )
+        if 4 * k > 128:
+            raise ValueError(f"z_export packs 4k lanes; k={k} > 32 unsupported")
     err = fused_support_error((n0, n1, n2), k, T.dtype.itemsize, bx, by, zpatch=zp)
     if err is not None:
         raise ValueError(err)
     if bx is None:
         bx, by = default_tile((n0, n1, n2), k, T.dtype.itemsize, zpatch=zp)
     fn = _build(n0, n1, n2, str(T.dtype), int(k),
-                float(cx), float(cy), float(cz), int(bx), int(by), zp)
+                float(cx), float(cy), float(cz), int(bx), int(by), zp,
+                bool(z_export), int(z_overlap) if z_export else 0)
     if zp:
         return fn(T, Cp, z_patch)
     return fn(T, Cp)
 
 
 @functools.lru_cache(maxsize=64)
-def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
+def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
+           zx: bool = False, o: int = 0):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -219,14 +244,17 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
     ntiles = ncx * ncy
 
     def kernel(*refs):
-        if zp:
+        ZXout = None
+        if zp and zx:
+            Tin, Cpin, ZPin, Tout, ZXout = refs
+        elif zp:
             Tin, Cpin, ZPin, Tout = refs
         else:
             Tin, Cpin, Tout = refs
             ZPin = None
 
         def body(tin, cpin, scratch, in_sems, cp_sems, out_sems,
-                 zpin=None, zp_sems=None):
+                 zpin=None, zp_sems=None, zex=None, zex_sems=None):
             # One flat tile index t = ix*ncy + iy; slot parity alternates
             # with t, so consecutive tiles always double-buffer.
             def ixy(t):
@@ -263,6 +291,16 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
                     zpin.at[slot], zp_sems.at[slot],
                 )
 
+            def zex_dma(t, slot):
+                ix, iy = ixy(t)
+                ox = ix * bx - sx_of(ix)
+                oy = pl.multiple_of(iy * by - sy_of(iy), 8)
+                return pltpu.make_async_copy(
+                    zex.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                    ZXout.at[pl.ds(ix * bx, bx), pl.ds(iy * by, by)],
+                    zex_sems.at[slot],
+                )
+
             in_dma(0, 0).start()
             cp_dma(0, 0).start()
             if zp:
@@ -277,8 +315,12 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
                     @pl.when(t >= 1)
                     def _():
                         # nslot still holds tile t-1's output; fence the
-                        # out-DMA before prefetching into it.
+                        # out-DMA (and the z-export DMA, whose staging slot
+                        # is rewritten at tile t+1's compute) before
+                        # prefetching into it.
                         out_dma(t - 1, nslot).wait()
+                        if zx:
+                            zex_dma(t - 1, nslot).wait()
 
                     in_dma(t + 1, nslot).start()
                     cp_dma(t + 1, nslot).start()
@@ -302,6 +344,18 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
                         step_into(scratch, tin[slot], minv, ring=(j == 0))
                     else:
                         step_into(tin.at[slot], scratch[:], minv, ring=False)
+                if zx:
+                    # z-slab export for the NEXT group's patch, extracted
+                    # here in VMEM where minor-dim plane surgery is free
+                    # (outside, these lane-unaligned slices relayout the
+                    # whole array — the z-anisotropy gap).  Post-step send
+                    # slabs sit >= k planes from the z edges (o >= 2k), so
+                    # the owned-block values are exact.
+                    zex[slot, :, :, 0:k] = tin[slot, :, :, n2 - o : n2 - o + k]
+                    zex[slot, :, :, k : 2 * k] = tin[slot, :, :, o - k : o]
+                    zex[slot, :, :, 2 * k : 3 * k] = tin[slot, :, :, 0:k]
+                    zex[slot, :, :, 3 * k : 4 * k] = tin[slot, :, :, n2 - k : n2]
+                    zex_dma(t, slot).start()
                 out_dma(t, slot).start()
                 return 0
 
@@ -310,6 +364,9 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
             # and they use distinct slots).
             out_dma(ntiles - 2, (ntiles - 2) % 2).wait()
             out_dma(ntiles - 1, (ntiles - 1) % 2).wait()
+            if zx:
+                zex_dma(ntiles - 2, (ntiles - 2) % 2).wait()
+                zex_dma(ntiles - 1, (ntiles - 1) % 2).wait()
 
         scopes = dict(
             tin=pltpu.VMEM((2, SX, SY, n2), dt_),
@@ -324,17 +381,28 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False):
                 zpin=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zp_sems=pltpu.SemaphoreType.DMA((2,)),
             )
+        if zx:
+            scopes.update(
+                zex=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zex_sems=pltpu.SemaphoreType.DMA((2,)),
+            )
         pl.run_scoped(body, **scopes)
 
     # 5 VMEM tiles (2 T slots, 2 Cp slots, 1 scratch) + Mosaic's own margin;
     # the default 16 MiB scoped-vmem budget rejects tiles past ~16x32, so
     # request what the kernel actually needs (v5e has 128 MiB VMEM).
     vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
+    out_shape = jax.ShapeDtypeStruct((n0, n1, n2), dt_)
+    if zx:
+        out_shape = (out_shape, jax.ShapeDtypeStruct((n0, n1, 128), dt_))
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n0, n1, n2), dt_),
+        out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (3 if zp else 2),
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_specs=(
+            [pl.BlockSpec(memory_space=pl.ANY)] * 2
+            if zx else pl.BlockSpec(memory_space=pl.ANY)
+        ),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_envelope.vmem_limit(2 * vmem_bytes)
         ),

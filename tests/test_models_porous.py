@@ -145,9 +145,48 @@ def test_pt_cadence_validation():
     state, params = pc.setup(10, 10, 10, npt=6, quiet=True)  # overlap 2
     with pytest.raises(ValueError, match="deep halo"):
         pc.make_multi_step(params, 2, exchange_every=2)
-    with pytest.raises(ValueError, match="multiple of exchange_every"):
-        pc.make_multi_step(params, 2, exchange_every=4)
     igg.finalize_global_grid()
+
+
+def test_pt_schedule():
+    """The ragged-npt chunking (round 4, VERDICT r3 #5: ``w | npt`` made the
+    kernel benefit depend on a numerics parameter)."""
+    from implicitglobalgrid_tpu.models.porous_convection3d import _pt_schedule
+
+    assert _pt_schedule(12, 6) == (0, [6, 6])
+    assert _pt_schedule(10, 6) == (0, [6, 4])
+    assert _pt_schedule(8, 6) == (0, [6, 2])
+    assert _pt_schedule(9, 6) == (1, [6, 2])
+    assert _pt_schedule(10, 2) == (0, [2] * 5)
+    assert _pt_schedule(1, 2) == (1, [])
+    assert _pt_schedule(5, 4) == (1, [4])
+    # w=1 admits no even kernel chunk — everything leads (regression: this
+    # case used to loop forever).
+    assert _pt_schedule(10, 1) == (10, [])
+    # The pure-XLA exchange_every cadence has no parity constraint: odd w
+    # keeps the user's requested group size (regression: even-rounding was
+    # wrongly applied, inflating the collective count by ~50%).
+    assert _pt_schedule(6, 3, even=False) == (0, [3, 3])
+    assert _pt_schedule(7, 3, even=False) == (0, [3, 3, 1])
+
+
+def test_ragged_cadence_matches_per_iteration():
+    """exchange_every with npt % w != 0 (ragged schedule) must still match
+    the per-iteration path at time-step boundaries."""
+    kw = dict(overlapx=8, overlapy=8, overlapz=8, npt=5, quiet=True)
+    state, params = pc.setup(18, 18, 18, **kw)
+    step = pc.make_multi_step(params, 2, donate=False)
+    ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = pc.setup(18, 18, 18, **kw)
+    step = pc.make_multi_step(params, 2, donate=False, exchange_every=4)
+    cad = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+    # Not bitwise: the lead iteration changes fusion boundaries, so the
+    # compiler contracts FMAs differently (f64 ULPs).
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), cad, ref):
+        np.testing.assert_allclose(g, r, rtol=1e-13, atol=1e-13, err_msg=name)
 
 
 def test_convection_starts_and_is_bounded():
@@ -188,6 +227,63 @@ def test_fused_single_device_matches_xla():
     with pltpu.force_tpu_interpret_mode():
         stepf = pc.make_multi_step(
             params, nt, donate=False, fused_k=2, fused_tile=(8, 16)
+        )
+        got = [np.asarray(A) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("npt,fused_k", [(10, 4), (5, 2)])
+def test_fused_ragged_npt_matches_xla(npt, fused_k):
+    """npt % fused_k != 0 (round 4, VERDICT r3 #5): the ragged schedule —
+    odd lead iteration + even kernel chunks, all exchanges at width w —
+    must match the per-iteration path.  (10, 4) -> chunks [4, 4, 2];
+    (5, 2) -> lead 1 + chunks [2, 2]."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 2
+    kw = dict(
+        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        overlapx=2 * fused_k, npt=npt, quiet=True, dtype=jax.numpy.float32,
+    )
+    state, params = pc.setup(16, 32, 128, **kw)
+    step = pc.make_multi_step(params, nt, donate=False)
+    ref = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = pc.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = pc.make_multi_step(
+            params, nt, donate=False, fused_k=fused_k, fused_tile=(8, 16)
+        )
+        got = [np.asarray(igg.gather(A)) for A in jax.block_until_ready(stepf(*state))]
+    igg.finalize_global_grid()
+    for name, g, r in zip(("T", "Pf", "qDx", "qDy", "qDz"), got, ref):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("npt", [10, 9])
+def test_fused_ragged_zpatch_periodic_z_matches_xla(npt):
+    """Ragged schedule through the in-kernel z-slab cadence (periodic
+    self-neighbor z): patch application and export both at width w for
+    every chunk, shorter chunks included."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 1
+    kw = dict(
+        devices=jax.devices()[:1], periodz=1, overlapz=8, npt=npt,
+        quiet=True, dtype=jax.numpy.float32,
+    )
+    state, params = pc.setup(16, 32, 128, **kw)
+    step = pc.make_multi_step(params, nt, donate=False)
+    ref = [np.asarray(A) for A in jax.block_until_ready(step(*state))]
+    igg.finalize_global_grid()
+
+    state, params = pc.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = pc.make_multi_step(
+            params, nt, donate=False, fused_k=4, fused_tile=(8, 16)
         )
         got = [np.asarray(A) for A in jax.block_until_ready(stepf(*state))]
     igg.finalize_global_grid()
@@ -252,10 +348,9 @@ def test_fused_validation():
         pc.make_multi_step(params, 2, fused_k=2)
     igg.finalize_global_grid()
     kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True)
-    state, params = pc.setup(10, 10, 10, npt=5, **kw)
-    with pytest.raises(ValueError, match="multiple of fused_k"):
-        pc.make_multi_step(params, 2, fused_k=2)
-    igg.finalize_global_grid()
+    # npt=5 with fused_k=2 is no longer rejected: the ragged schedule (one
+    # leading XLA iteration + [2, 2]) runs it — equivalence covered by
+    # test_fused_ragged_npt_matches_xla.
     state, params = pc.setup(10, 10, 10, npt=4, **kw)
     with pytest.raises(ValueError, match="conflicts"):
         pc.make_multi_step(params, 2, fused_k=2, exchange_every=4)
