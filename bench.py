@@ -73,8 +73,13 @@ def main():
     def _fused_record(r):
         # Path provenance comes from the harness itself now
         # (benchmarks/run.py::_fused_provenance — the same envelope check
-        # the model's fallback uses, evaluated on the actual local block).
-        return {"teff": r["value"], "t_it_ms": r["t_it_ms"], "path": r.get("path")}
+        # the model's fallback uses, evaluated on the actual local block);
+        # per-rep spread rides along (VERDICT r3 #7: cross-round drift on a
+        # time-shared chip is uninterpretable without it).
+        return {
+            "teff": r["value"], "t_it_ms": r["t_it_ms"], "path": r.get("path"),
+            "spread": r.get("spread"),
+        }
 
     def _fused():
         r = _bench.bench_diffusion(
@@ -136,21 +141,42 @@ def main():
 
     def _porous_fused():
         # The fused PT kernel (ops/pallas_pt.py) needs a 128-multiple minor
-        # dim -> 256^3.  w must divide npt: npt=12 admits the tuned w=6
-        # (like the leapfrog, deeper blocking wins on the VPU-heavy
-        # staggered kernels); npt=10 only admits w=2 — also recorded, as
-        # the config closest to the round-2 npt=10 number.
+        # dim -> 256^3.  Since round 4 the ragged schedule lifts the old
+        # ``w | npt`` restriction, so npt=10 (a physically ordinary choice)
+        # runs the tuned w=6 as chunks [6, 4] — recorded alongside npt=12
+        # (VERDICT r3 #5's done criterion: npt=10 within 15% of npt=12).
         r6 = _bench.bench_porous(
             n=256, chunk=2, reps=3, npt=12, dtype="float32", emit=False, fused_k=6
         )
-        r2 = _bench.bench_porous(
-            n=256, chunk=2, reps=3, npt=10, dtype="float32", emit=False, fused_k=2
+        r10 = _bench.bench_porous(
+            n=256, chunk=2, reps=3, npt=10, dtype="float32", emit=False, fused_k=6
         )
         rec = _fused_record(r6)
         rec["t_pt_ms"] = r6.get("t_pt_ms")
         rec["npt12_w6"] = {"teff": r6["value"], "t_pt_ms": r6.get("t_pt_ms")}
-        rec["npt10_w2"] = {"teff": r2["value"], "t_pt_ms": r2.get("t_pt_ms")}
+        rec["npt10_w6_ragged"] = {"teff": r10["value"], "t_pt_ms": r10.get("t_pt_ms")}
         return rec
+
+    def _diffusion_periodz_fused():
+        # The z-active fused diffusion record (VERDICT r3 #1's done
+        # criterion): periodic-z self-neighbor 256^3, deep halo overlapz=8,
+        # k=4 — the in-kernel z-slab apply + export cadence
+        # (docs/performance.md's exchanged-dimension anisotropy section).
+        r = _bench.bench_diffusion(
+            n=256, chunk=24, reps=3, dtype="float32", emit=False, fused_k=4,
+            overlap=8, period="z",
+        )
+        return _fused_record(r)
+
+    def _acoustic_periodz_fused():
+        # Same degenerate config for the staggered kernel family (VERDICT
+        # r3 #4: round-3 stopped at receive-side application, 557 GB/s; the
+        # round-4 in-kernel export cadence measured 625).
+        r = _bench.bench_acoustic(
+            n=256, chunk=24, reps=3, dtype="float32", emit=False, fused_k=6,
+            overlap=12, period="z",
+        )
+        return _fused_record(r)
 
     _extra("diffusion_pallas_fused4", _fused)
     _extra("diffusion_512_pallas_fused4", _fused512)
@@ -160,6 +186,8 @@ def main():
     _extra("acoustic_256_pallas_fused6", _acoustic_fused)
     _extra("porous_pt", _porous)
     _extra("porous_256_pallas_fused", _porous_fused)
+    _extra("diffusion_periodz_pallas_fused4", _diffusion_periodz_fused)
+    _extra("acoustic_periodz_pallas_fused6", _acoustic_periodz_fused)
     best = rec["value"]
     extras["headline_path"] = "xla"
     fused = extras.get("diffusion_pallas_fused4", {})

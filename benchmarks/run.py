@@ -155,6 +155,20 @@ def _fused_provenance(fused_k, support_error, local_shape, itemsize, fused_tile)
     return f"_fused{fused_k}fb", "xla-fallback"
 
 
+def _grid_kwargs(overlap, period):
+    """Shared setup kwargs for overlap/period CLI knobs (one definition for
+    all three benchmarks).  Validates the period axis letters eagerly — a
+    typo'd axis would otherwise surface as an opaque setup() TypeError."""
+    kw = {} if overlap is None else dict(
+        overlapx=overlap, overlapy=overlap, overlapz=overlap
+    )
+    for ax in period or "":
+        if ax not in "xyz":
+            raise ValueError(f"--period axes must be from 'xyz', got {period!r}")
+        kw[f"period{ax}"] = 1
+    return kw
+
+
 def _emit(name, teff, t_it, extra=None, emit=True):
     rec = {
         "metric": name,
@@ -187,11 +201,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
-    okw = {} if overlap is None else dict(
-        overlapx=overlap, overlapy=overlap, overlapz=overlap
-    )
-    for ax in period or "":
-        okw[f"period{ax}"] = 1
+    okw = _grid_kwargs(overlap, period)
     state, params = diffusion3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices, force_spmd=force_spmd, **okw,
@@ -239,11 +249,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
-    okw = {} if overlap is None else dict(
-        overlapx=overlap, overlapy=overlap, overlapz=overlap
-    )
-    for ax in period or "":
-        okw[f"period{ax}"] = 1
+    okw = _grid_kwargs(overlap, period)
     state, params = acoustic3d.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), hide_comm=hide_comm, quiet=True,
         devices=devices, **okw,
@@ -292,11 +298,7 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
-    okw = {} if overlap is None else dict(
-        overlapx=overlap, overlapy=overlap, overlapz=overlap
-    )
-    for ax in period or "":
-        okw[f"period{ax}"] = 1
+    okw = _grid_kwargs(overlap, period)
     state, params = pc.setup(
         n, n, n, dtype=jax.numpy.dtype(dtype), npt=npt, quiet=True, devices=devices,
         **okw,

@@ -53,10 +53,10 @@ _TILE_CANDIDATES = ((32, 64), (16, 64), (32, 32), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
+def _tile_bytes(n2, k, bx, by, itemsize, zsets: int = 0):
     """VMEM bytes: 4 ping-pong fields x (2 slots + scratch) + 2 T slots
-    (+ the double-buffered 128-lane z-patch windows and z-export staging
-    slots when ``zpatch``)."""
+    plus ``zsets`` four-field double-buffered 128-lane window sets (1 = the
+    z-patch input windows, 2 = + the z-export staging slots)."""
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     per_set = (
@@ -66,10 +66,9 @@ def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
         + SX * SY * (n2 + 128)  # qDz
     )
     total = 3 * per_set + 2 * SX * SY * n2
-    if zpatch:
-        total += 4 * 128 * (
-            SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
-        )
+    total += zsets * 2 * 128 * (
+        SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
+    )
     return total * itemsize
 
 
@@ -77,24 +76,40 @@ _tile_error = _envelope.make_tile_error(
     _tile_bytes, _VMEM_BUDGET_BYTES, "14 haloed staggered tiles spanning z"
 )
 _tile_error_zpatch = _envelope.make_tile_error(
-    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, True),
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 1),
     _VMEM_BUDGET_BYTES,
     "14 haloed staggered tiles spanning z + 8 z-patch windows",
 )
+_tile_error_zexport = _envelope.make_tile_error(
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 2),
+    _VMEM_BUDGET_BYTES,
+    "14 haloed staggered tiles spanning z + z-patch windows + export staging",
+)
 
 
-def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False):
-    """First tuned tile candidate valid for cell ``shape``, or None."""
+def _pick_tile_error(zpatch, zexport):
+    if zpatch and zexport:
+        return _tile_error_zexport
+    return _tile_error_zpatch if zpatch else _tile_error
+
+
+def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
+                 zexport: bool | None = None):
+    """First tuned tile candidate valid for cell ``shape``, or None.
+
+    ``zexport`` defaults to ``zpatch`` (the production z-slab cadence always
+    exports); pass ``zexport=False`` for a patch-only call."""
     return _envelope.default_tile(
         shape, k, itemsize,
-        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
         candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_support_error(shape, k: int, itemsize: int = 4,
                         bx: int | None = None, by: int | None = None,
-                        zpatch: bool = False) -> str | None:
+                        zpatch: bool = False,
+                        zexport: bool | None = None) -> str | None:
     """Why the fused PT kernel cannot run this cell shape, or None.
 
     Shared control flow in `ops/_fused_envelope.py`; only `_tile_error`'s
@@ -105,7 +120,7 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
         candidates=_TILE_CANDIDATES,
     )
 
@@ -177,11 +192,15 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
             raise ValueError(
                 f"z_export packs 4*we lanes; z_export_width={we} > 32 unsupported"
             )
-    err = fused_support_error((n0, n1, n2), k, Pf.dtype.itemsize, bx, by, zpatch=zp)
+    err = fused_support_error(
+        (n0, n1, n2), k, Pf.dtype.itemsize, bx, by, zpatch=zp, zexport=z_export
+    )
     if err is not None:
         raise ValueError(err)
     if bx is None:
-        bx, by = default_tile((n0, n1, n2), k, Pf.dtype.itemsize, zpatch=zp)
+        bx, by = default_tile(
+            (n0, n1, n2), k, Pf.dtype.itemsize, zpatch=zp, zexport=z_export
+        )
     fn = _build(n0, n1, n2, str(Pf.dtype), int(k),
                 float(th), float(idx), float(idy), float(idz),
                 float(ralam), float(bp), int(bx), int(by), zp,
@@ -528,7 +547,7 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
             )
         pl.run_scoped(body, **scopes)
 
-    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, (2 if zx else 1) if zp else 0)
     out_shape = [
         jax.ShapeDtypeStruct((n0, n1, n2), dt_),
         jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),

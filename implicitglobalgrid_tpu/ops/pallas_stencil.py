@@ -69,15 +69,14 @@ _TILE_CANDIDATES = ((32, 64), (16, 64), (32, 32), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 100 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize, zpatch: bool = False):
+def _tile_bytes(n2, k, bx, by, itemsize, zslots: int = 0):
     """VMEM bytes for the 5-tile working set (2 T slots, 2 Cp slots, scratch)
-    plus, when ``zpatch``, the double-buffered 128-lane z-patch windows AND
-    the z-export staging slots (``Cp`` is frozen — only ``T`` carries
-    patches)."""
+    plus ``zslots`` double-buffered 128-lane window sets (2 for the z-patch
+    input windows, +2 when the z-export staging slots are also allocated;
+    ``Cp`` is frozen — only ``T`` carries patches)."""
     H = _envelope.aligned_halo(k)
     total = 5 * (bx + 2 * k) * (by + 2 * H) * n2
-    if zpatch:
-        total += 4 * (bx + 2 * k) * (by + 2 * H) * 128
+    total += zslots * (bx + 2 * k) * (by + 2 * H) * 128
     return total * itemsize
 
 
@@ -87,24 +86,40 @@ _tile_error = _envelope.make_tile_error(
     "5 haloed tiles spanning z, v5e-tuned — see _VMEM_BUDGET_BYTES",
 )
 _tile_error_zpatch = _envelope.make_tile_error(
-    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, True),
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 2),
     _VMEM_BUDGET_BYTES,
     "5 haloed tiles spanning z + 2 z-patch windows",
 )
+_tile_error_zexport = _envelope.make_tile_error(
+    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 4),
+    _VMEM_BUDGET_BYTES,
+    "5 haloed tiles spanning z + z-patch windows + export staging",
+)
 
 
-def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False):
-    """First tuned tile candidate valid for ``shape``, or None if none fits."""
+def _pick_tile_error(zpatch, zexport):
+    if zpatch and zexport:
+        return _tile_error_zexport
+    return _tile_error_zpatch if zpatch else _tile_error
+
+
+def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
+                 zexport: bool | None = None):
+    """First tuned tile candidate valid for ``shape``, or None if none fits.
+
+    ``zexport`` defaults to ``zpatch`` — the production z-slab cadence
+    always exports; pass ``zexport=False`` for a patch-only call."""
     return _envelope.default_tile(
         shape, k, itemsize,
-        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
         candidates=_TILE_CANDIDATES,
     )
 
 
 def fused_support_error(shape, k: int, itemsize: int = 4,
                         bx: int | None = None, by: int | None = None,
-                        zpatch: bool = False) -> str | None:
+                        zpatch: bool = False,
+                        zexport: bool | None = None) -> str | None:
     """Why the fused kernel cannot run this config, or None if it can.
 
     The single source of truth for the kernel's shape/tile envelope — used
@@ -116,11 +131,12 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     flow) live in `ops/_fused_envelope.py`, shared with the staggered
     leapfrog kernel; only `_tile_error`'s VMEM accounting is specific.
     ``zpatch`` accounts for the in-kernel z-exchange variant's T patch
-    windows.
+    windows; ``zexport`` (default = ``zpatch``, the production cadence) for
+    the export staging slots on top.
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_tile_error_zpatch if zpatch else _tile_error,
+        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
         candidates=_TILE_CANDIDATES,
     )
 
@@ -173,11 +189,15 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
             )
         if 4 * k > 128:
             raise ValueError(f"z_export packs 4k lanes; k={k} > 32 unsupported")
-    err = fused_support_error((n0, n1, n2), k, T.dtype.itemsize, bx, by, zpatch=zp)
+    err = fused_support_error(
+        (n0, n1, n2), k, T.dtype.itemsize, bx, by, zpatch=zp, zexport=z_export
+    )
     if err is not None:
         raise ValueError(err)
     if bx is None:
-        bx, by = default_tile((n0, n1, n2), k, T.dtype.itemsize, zpatch=zp)
+        bx, by = default_tile(
+            (n0, n1, n2), k, T.dtype.itemsize, zpatch=zp, zexport=z_export
+        )
     fn = _build(n0, n1, n2, str(T.dtype), int(k),
                 float(cx), float(cy), float(cz), int(bx), int(by), zp,
                 bool(z_export), int(z_overlap) if z_export else 0)
@@ -391,7 +411,7 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
     # 5 VMEM tiles (2 T slots, 2 Cp slots, 1 scratch) + Mosaic's own margin;
     # the default 16 MiB scoped-vmem budget rejects tiles past ~16x32, so
     # request what the kernel actually needs (v5e has 128 MiB VMEM).
-    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, zp)
+    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, (4 if zx else 2) if zp else 0)
     out_shape = jax.ShapeDtypeStruct((n0, n1, n2), dt_)
     if zx:
         out_shape = (out_shape, jax.ShapeDtypeStruct((n0, n1, 128), dt_))
