@@ -63,6 +63,22 @@ def vmem_limit(need_bytes: int) -> int:
     return min(vmem_budget(110 * 1024 * 1024), need_bytes + 16 * 1024 * 1024)
 
 
+def pick_tile_error(base, patch, export, zpatch, zexport=None):
+    """Select a kernel's ``tile_error`` for the requested z-window mode.
+
+    ``zexport=None`` defaults to ``zpatch`` — the production z-slab cadence
+    always exports, so callers that only say "zpatch" budget for the full
+    variant; pass ``zexport=False`` for a patch-only kernel call.  One
+    definition for all three kernels (this module's contract: shared
+    envelope control flow lands in ONE place).
+    """
+    if zexport is None:
+        zexport = zpatch
+    if zpatch and zexport:
+        return export
+    return patch if zpatch else base
+
+
 def make_tile_error(tile_bytes, budget, desc):
     """Build a kernel's ``tile_error`` from its VMEM accounting.
 

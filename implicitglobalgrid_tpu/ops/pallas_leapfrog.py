@@ -72,9 +72,10 @@ from . import _fused_envelope as _envelope
 #: Tile candidates for auto-selection, fastest first (shared heuristics with
 #: the diffusion kernel; the 4-field working set is ~2.4x larger, so the
 #: VMEM check prunes earlier — the intermediate rungs matter here most:
-#: 512^3 rejects (32,64) and round 3 degraded straight to (16,32),
-#: VERDICT r3 #6).
-_TILE_CANDIDATES = ((32, 64), (16, 64), (32, 32), (16, 32), (8, 16))
+#: 512^3 rejects (32,64) and round 3 degraded straight to (16,32) at 959
+#: GB/s; the (32,32) rung measures 1409 there (vs (16,64) 1296), hence its
+#: rank (VERDICT r3 #6).
+_TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 
 #: See `ops.pallas_stencil._VMEM_BUDGET_BYTES` (v5e-tuned module constant).
 #: Lower than the diffusion kernel's 100 MiB: Mosaic's real scoped-stack need
@@ -119,12 +120,6 @@ _tile_error_zexport = _envelope.make_tile_error(
 )
 
 
-def _pick_tile_error(zpatch, zexport):
-    if zpatch and zexport:
-        return _tile_error_zexport
-    return _tile_error_zpatch if zpatch else _tile_error
-
-
 def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
                  zexport: bool | None = None):
     """First tuned tile candidate valid for cell ``shape``, or None.
@@ -133,7 +128,10 @@ def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
     exports); pass ``zexport=False`` for a patch-only call."""
     return _envelope.default_tile(
         shape, k, itemsize,
-        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
+        tile_error=_envelope.pick_tile_error(
+            _tile_error, _tile_error_zpatch, _tile_error_zexport,
+            zpatch, zexport,
+        ),
         candidates=_TILE_CANDIDATES,
     )
 
@@ -155,7 +153,10 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
+        tile_error=_envelope.pick_tile_error(
+            _tile_error, _tile_error_zpatch, _tile_error_zexport,
+            zpatch, zexport,
+        ),
         candidates=_TILE_CANDIDATES,
     )
 

@@ -46,7 +46,7 @@ from .pallas_leapfrog import (  # noqa: F401  (re-export)
     z_patch_shapes,
 )
 
-_TILE_CANDIDATES = ((32, 64), (16, 64), (32, 32), (16, 32), (8, 16))
+_TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 
 #: See `ops.pallas_leapfrog._VMEM_BUDGET_BYTES` (Mosaic's scoped stack runs
 #: ~18% past the buffer-byte estimate on the staggered sets).
@@ -87,12 +87,6 @@ _tile_error_zexport = _envelope.make_tile_error(
 )
 
 
-def _pick_tile_error(zpatch, zexport):
-    if zpatch and zexport:
-        return _tile_error_zexport
-    return _tile_error_zpatch if zpatch else _tile_error
-
-
 def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
                  zexport: bool | None = None):
     """First tuned tile candidate valid for cell ``shape``, or None.
@@ -101,7 +95,10 @@ def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
     exports); pass ``zexport=False`` for a patch-only call."""
     return _envelope.default_tile(
         shape, k, itemsize,
-        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
+        tile_error=_envelope.pick_tile_error(
+            _tile_error, _tile_error_zpatch, _tile_error_zexport,
+            zpatch, zexport,
+        ),
         candidates=_TILE_CANDIDATES,
     )
 
@@ -120,7 +117,10 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     """
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
-        tile_error=_pick_tile_error(zpatch, zpatch if zexport is None else zexport),
+        tile_error=_envelope.pick_tile_error(
+            _tile_error, _tile_error_zpatch, _tile_error_zexport,
+            zpatch, zexport,
+        ),
         candidates=_TILE_CANDIDATES,
     )
 

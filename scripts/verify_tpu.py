@@ -486,11 +486,13 @@ def check_zpatch_export_aot():
     assert n_cp >= 10, f"expected >= 10 collective-permutes, got {n_cp}"
     # The z hop must move packed (n0, n1, k) slabs, NOT full arrays — the
     # point of the export design.  Local block (16,32,128), k=2: count the
-    # thin-slab permutes among the collective-permute ops.
+    # thin-slab permute OPS (start/sync forms only — an async op's matching
+    # -done line would double-count the same hop).
     thin = sum(
         1
         for line in txt.splitlines()
-        if "collective-permute" in line and "f32[16,32,2]" in line
+        if ("collective-permute-start(" in line or "collective-permute(" in line)
+        and "f32[16,32,2]" in line
     )
     assert thin >= 2, (
         f"expected >= 2 packed (16,32,2) z-slab collective-permutes, got {thin}"
