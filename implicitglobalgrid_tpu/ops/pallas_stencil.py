@@ -62,12 +62,14 @@ _TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 
 #: Deep-z volumes (n2 >= 512) amortize a longer pipeline: (32,128) measured
 #: +6% over (32,64) at 512^3 k=4 (609 vs 573 GB/s) but slightly BELOW it at
-#: 256^3 — so it leads the ladder only when n2 qualifies.
+#: 256^3 — so it leads the ladder only when n2 qualifies.  k <= 4 only: the
+#: k=6 + (32,128) + 512-deep combination crashes the TPU compile helper
+#: (probed round 4), so deeper blocking falls back to the plain ladder.
 _TILE_CANDIDATES_DEEP_Z = ((32, 128),) + _TILE_CANDIDATES
 
 
-def _candidates(n2):
-    return _TILE_CANDIDATES_DEEP_Z if n2 >= 512 else _TILE_CANDIDATES
+def _candidates(n2, k):
+    return _TILE_CANDIDATES_DEEP_Z if (n2 >= 512 and k <= 4) else _TILE_CANDIDATES
 
 #: VMEM the kernel may plan against.  v5e/v5p carry 128 MiB per core; 100 MiB
 #: leaves Mosaic's own margin.  Not a device query (jax's public API does not
@@ -119,7 +121,7 @@ def default_tile(shape, k: int, itemsize: int = 4, zpatch: bool = False,
             _tile_error, _tile_error_zpatch, _tile_error_zexport,
             zpatch, zexport,
         ),
-        candidates=_candidates(shape[2]),
+        candidates=_candidates(shape[2], k),
     )
 
 
@@ -141,13 +143,22 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     windows; ``zexport`` (default = ``zpatch``, the production cadence) for
     the export staging slots on top.
     """
+    if by is not None and by >= 128 and k > 4 and shape[2] >= 512:
+        # Probed (round 4): (32,128) + k=6 + 512-deep z crashes the TPU
+        # compile helper outright — reject here so explicit tiles get the
+        # warn-once XLA fallback instead of a hard crash (the auto ladder
+        # already gates the deep-z rung to k <= 4).
+        return (
+            f"tile (..,{by}) with k={k} at z>={shape[2]} crashes the TPU "
+            "compiler (probed); use k <= 4 or by <= 64"
+        )
     return _envelope.support_error(
         shape, k, itemsize, bx, by,
         tile_error=_envelope.pick_tile_error(
             _tile_error, _tile_error_zpatch, _tile_error_zexport,
             zpatch, zexport,
         ),
-        candidates=_candidates(shape[2]),
+        candidates=_candidates(shape[2], k),
     )
 
 

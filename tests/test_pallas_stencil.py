@@ -139,13 +139,21 @@ def test_bfloat16_structure():
 def test_auto_tile_fallback():
     # Volumes the tuned (32,64) tile does not fit fall back to smaller
     # candidates instead of raising (the old fixed default rejected them).
-    from implicitglobalgrid_tpu.ops.pallas_stencil import default_tile
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        default_tile,
+        fused_support_error,
+    )
 
     assert default_tile((64, 128, 128), 2) == (32, 64)
     # 64 does not divide 96; the (32,32) rung (round 4) beats the old (16,32)
     assert default_tile((96, 96, 128), 2) == (32, 32)
-    # Deep-z volumes lead with the (32,128) rung (measured +6% at 512^3).
+    # Deep-z volumes lead with the (32,128) rung (measured +6% at 512^3) —
+    # k <= 4 only: the k=6 combination crashes the TPU compiler (probed),
+    # both in auto-selection and as an explicit tile.
     assert default_tile((64, 256, 512), 4) == (32, 128)
+    assert default_tile((64, 256, 512), 6) == (32, 64)
+    err = fused_support_error((64, 256, 512), 6, 4, 32, 128)
+    assert err is not None and "crashes the TPU compiler" in err
     assert default_tile((64, 128, 512), 4) == (32, 64)  # 128 < SY=144
     assert default_tile((32, 64, 128), 2) == (16, 32)   # ncy=1 at by=64
     assert default_tile((16, 32, 128), 2) == (8, 16)  # too small for 16x32 halos
