@@ -62,14 +62,23 @@ _TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 
 #: Deep-z volumes (n2 >= 512) amortize a longer pipeline: (32,128) measured
 #: +6% over (32,64) at 512^3 k=4 (609 vs 573 GB/s) but slightly BELOW it at
-#: 256^3 — so it leads the ladder only when n2 qualifies.  k <= 4 only: the
-#: k=6 + (32,128) + 512-deep combination crashes the TPU compile helper
-#: (probed round 4), so deeper blocking falls back to the plain ladder.
+#: 256^3 — so it leads the ladder only when n2 qualifies and `_deep_z_crash`
+#: clears the k.
 _TILE_CANDIDATES_DEEP_Z = ((32, 128),) + _TILE_CANDIDATES
 
 
+def _deep_z_crash(by, k, n2):
+    """The probed (round 4) TPU compile-helper crash envelope: wide tiles
+    (by >= 128) with k > 4 at 512-deep z.  ONE predicate behind both the
+    auto-ladder gate and the explicit-tile rejection, so the two can never
+    disagree about which combinations are legal."""
+    return by >= 128 and k > 4 and n2 >= 512
+
+
 def _candidates(n2, k):
-    return _TILE_CANDIDATES_DEEP_Z if (n2 >= 512 and k <= 4) else _TILE_CANDIDATES
+    if n2 >= 512 and not _deep_z_crash(128, k, n2):
+        return _TILE_CANDIDATES_DEEP_Z
+    return _TILE_CANDIDATES
 
 #: VMEM the kernel may plan against.  v5e/v5p carry 128 MiB per core; 100 MiB
 #: leaves Mosaic's own margin.  Not a device query (jax's public API does not
@@ -143,11 +152,10 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
     windows; ``zexport`` (default = ``zpatch``, the production cadence) for
     the export staging slots on top.
     """
-    if by is not None and by >= 128 and k > 4 and shape[2] >= 512:
-        # Probed (round 4): (32,128) + k=6 + 512-deep z crashes the TPU
-        # compile helper outright — reject here so explicit tiles get the
-        # warn-once XLA fallback instead of a hard crash (the auto ladder
-        # already gates the deep-z rung to k <= 4).
+    if by is not None and _deep_z_crash(by, k, shape[2]):
+        # Reject here so explicit tiles get the warn-once XLA fallback
+        # instead of a hard crash (the auto ladder gates the deep-z rung
+        # through the same predicate).
         return (
             f"tile (..,{by}) with k={k} at z>={shape[2]} crashes the TPU "
             "compiler (probed); use k <= 4 or by <= 64"

@@ -335,6 +335,30 @@ def test_fused_zpatch_deep_halo_z_split_matches_xla():
     np.testing.assert_allclose(T_got, T_ref, rtol=1e-5, atol=1e-5)
 
 
+def test_fused_zpatch_periodic_z_multiblock_matches_xla():
+    """Periodic z with dims_z=2: the packed exports communicate via the
+    wrap ppermute (neither the self-neighbor fast path nor the PROC_NULL
+    masking — the third topology of `z_patch_from_export`)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 4
+    kw = dict(
+        devices=jax.devices()[:2], dimx=1, dimy=1, dimz=2, periodz=1,
+        overlapz=4, quiet=True, dtype=jax.numpy.float32,
+    )
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    step = diffusion3d.make_multi_step(params, nt, donate=False)
+    T_ref = np.asarray(igg.gather(jax.block_until_ready(step(*state))[0]))
+    igg.finalize_global_grid()
+
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = diffusion3d.make_multi_step(params, nt, donate=False, fused_k=2)
+        T_got = np.asarray(igg.gather(jax.block_until_ready(stepf(*state))[0]))
+    igg.finalize_global_grid()
+    np.testing.assert_allclose(T_got, T_ref, rtol=1e-5, atol=1e-5)
+
+
 def test_fused_zpatch_periodic_z_matches_xla():
     """Same cadence on the periodic self-neighbor z config (1 device)."""
     from jax.experimental.pallas import tpu as pltpu

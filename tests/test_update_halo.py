@@ -121,7 +121,11 @@ def put(arr_np):
 
     gg = igg.get_global_grid()
     spec = P(*igg.AXIS_NAMES[: arr_np.ndim])
-    return jax.device_put(jnp.asarray(arr_np), NamedSharding(gg.mesh, spec))
+    # device_put straight from host memory: an intermediate committed
+    # jax.Array (jnp.asarray) can route device_put through jax's
+    # different-device-order reshard path, which trips an internal assert
+    # under the loaded full-suite run (observed as an order-dependent flake).
+    return jax.device_put(np.asarray(arr_np), NamedSharding(gg.mesh, spec))
 
 
 def check(config, fields_lshapes, dtype=np.float64, width=1, **initkw):
