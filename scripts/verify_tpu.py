@@ -33,7 +33,9 @@ Checks:
 10. the round-4 z-patch export cadence AOT-compiled for the same 8-chip
     topology with a REAL z split: one fused group (in-kernel patch apply +
     z-slab export) + x/y exchanges of field and packed export + the packed
-    z communication (`z_patch_from_export`) in one program.
+    z communication (`z_patch_from_export`) in one program,
+11. the same production cadence scaled to a 16-chip (4,2,2) topology with
+    TWO pipelined kernel groups — the weak-scaling compile proxy.
 """
 
 import os
@@ -387,15 +389,17 @@ def check_multichip_fused_aot():
     )
 
 
-def _aot_zpatch_fused_hlo():
-    """AOT-compile one diffusion z-patch-export group over a 2x2x2 mesh.
+def _aot_zpatch_fused_hlo(dims=(2, 2, 2), k=2, groups=1):
+    """AOT-compile ``groups`` diffusion z-patch-export group(s) over a mesh.
 
     Same synthetic-GlobalGrid technique as `_aot_staggered_fused_hlo`, but
     the mesh has a real z split, so the compiled program must contain the
     Mosaic kernel (with its z-export output), the x/y collective-permute
     slab exchanges of BOTH the field and the packed export, and the packed
-    z communication of `z_patch_from_export`."""
+    z communication of `z_patch_from_export`.  ``dims=(4,2,2)`` with
+    ``groups=2`` is the 16-chip production-shape variant (check 11)."""
     import dataclasses
+    import math
 
     import numpy as np
 
@@ -403,9 +407,14 @@ def _aot_zpatch_fused_hlo():
     from jax.experimental import topologies
     from jax.sharding import Mesh
 
+    nchips = math.prod(dims)
     kind = jax.devices()[0].device_kind
     topo = None
-    for name in (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"):
+    names = {
+        8: (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"),
+        16: (f"{kind}:4x4", "v5e:4x4", "v5litepod-16"),
+    }[nchips]
+    for name in names:
         try:
             topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
             break
@@ -413,18 +422,21 @@ def _aot_zpatch_fused_hlo():
             continue
     if topo is None:
         raise RuntimeError("no AOT topology description available")
-    devs = np.asarray(topo.devices)[:8].reshape(2, 2, 2)
+    devs = np.asarray(topo.devices)[:nchips].reshape(dims)
     mesh = Mesh(devs, ("x", "y", "z"))
+    o = 2 * k
 
     import implicitglobalgrid_tpu as igg
     from implicitglobalgrid_tpu.parallel import grid as _grid
 
     igg.init_global_grid(
-        16, 32, 128, overlapx=4, overlapy=4, overlapz=4, quiet=True,
+        16, 32, 128, overlapx=o, overlapy=o, overlapz=o, quiet=True,
         devices=list(jax.devices())[:1],
     )
     gg0 = igg.get_global_grid()
-    gg = dataclasses.replace(gg0, mesh=mesh, dims=(2, 2, 2), nprocs=8, coords=(0, 0, 0))
+    gg = dataclasses.replace(
+        gg0, mesh=mesh, dims=tuple(dims), nprocs=nchips, coords=(0, 0, 0)
+    )
     _grid.set_global_grid(gg)
     try:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -440,14 +452,16 @@ def _aot_zpatch_fused_hlo():
         c = 1e-3 / 0.01
 
         def block_step(T, Cp):
-            patch = identity_z_patch(T, width=2)
-            T, zex = fused_diffusion_steps(
-                T, Cp, 2, c, c, c, bx=8, by=16, z_patch=patch,
-                z_export=True, z_overlap=4,
-            )
-            T = exchange_dims(T, (0, 1), width=2)
-            zex = exchange_dims(zex, (0, 1), width=2)
-            return apply_z_patch(T, z_patch_from_export(zex, width=2), width=2)
+            patch = identity_z_patch(T, width=k)
+            for _ in range(groups):
+                T, zex = fused_diffusion_steps(
+                    T, Cp, k, c, c, c, bx=8, by=16, z_patch=patch,
+                    z_export=True, z_overlap=o,
+                )
+                T = exchange_dims(T, (0, 1), width=k)
+                zex = exchange_dims(zex, (0, 1), width=k)
+                patch = z_patch_from_export(zex, width=k)
+            return apply_z_patch(T, patch, width=k)
 
         mapped = jax.jit(
             jax.shard_map(
@@ -458,8 +472,9 @@ def _aot_zpatch_fused_hlo():
             )
         )
         spec = NamedSharding(mesh, P("x", "y", "z"))
+        gshape = (16 * dims[0], 32 * dims[1], 128 * dims[2])
         avals = tuple(
-            jax.ShapeDtypeStruct((32, 64, 256), np.float32, sharding=spec)
+            jax.ShapeDtypeStruct(gshape, np.float32, sharding=spec)
             for _ in range(2)
         )
         return mapped.lower(*avals).compile().as_text()
@@ -504,6 +519,33 @@ def check_zpatch_export_aot():
     )
 
 
+def check_zpatch_export_aot_16chip():
+    """Scale the production cadence compile to 16 chips, two groups — the
+    weak-scaling compile proxy at (4,2,2): the program must pipeline two
+    kernel groups with packed z hops between them."""
+    try:
+        txt = _aot_zpatch_fused_hlo(dims=(4, 2, 2), k=4, groups=2)
+    except Exception as e:  # noqa: BLE001
+        print(
+            f"11. 16-chip production cadence AOT: SKIPPED ({type(e).__name__}: {e})"
+        )
+        return
+    assert "tpu_custom_call" in txt, "no Mosaic kernel custom-call"
+    n_cp = txt.count("collective-permute-start(") + txt.count("collective-permute(")
+    thin = sum(
+        1
+        for line in txt.splitlines()
+        if ("collective-permute-start(" in line or "collective-permute(" in line)
+        and "f32[16,32,4]" in line
+    )
+    assert n_cp >= 20, f"expected >= 20 collective-permutes (2 groups), got {n_cp}"
+    assert thin >= 4, f"expected >= 4 packed z hops (2 groups x 2), got {thin}"
+    print(
+        f"11. 16-chip (4,2,2) production cadence AOT: OK — 2 pipelined kernel "
+        f"groups, {n_cp} collective-permutes, {thin} packed (16,32,4) z hops"
+    )
+
+
 def check_pt_fused():
     import jax.numpy as jnp
     import numpy as np
@@ -543,4 +585,5 @@ if __name__ == "__main__":
     check_pt_fused()
     check_multichip_fused_aot()
     check_zpatch_export_aot()
+    check_zpatch_export_aot_16chip()
     print("ALL TPU CHECKS PASSED")
