@@ -359,6 +359,35 @@ def test_fused_zpatch_periodic_z_multiblock_matches_xla():
     np.testing.assert_allclose(T_got, T_ref, rtol=1e-5, atol=1e-5)
 
 
+def test_fused_zpatch_periodic_z_bfloat16():
+    """The z-patch/export cadence at bf16 (itemsize 2): packing, patch
+    application, and export must be dtype-clean — compared against the XLA
+    bf16 path at bf16 accuracy."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nt = 2
+    kw = dict(
+        devices=jax.devices()[:1], periodz=1, overlapz=4, quiet=True,
+        dtype=jax.numpy.bfloat16,
+    )
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    step = diffusion3d.make_multi_step(params, nt, donate=False)
+    T_ref = np.asarray(
+        jax.block_until_ready(step(*state))[0].astype(jax.numpy.float32)
+    )
+    igg.finalize_global_grid()
+
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    with pltpu.force_tpu_interpret_mode():
+        stepf = diffusion3d.make_multi_step(params, nt, donate=False, fused_k=2)
+        T_got = np.asarray(
+            jax.block_until_ready(stepf(*state))[0].astype(jax.numpy.float32)
+        )
+    igg.finalize_global_grid()
+    # bf16 has ~3 decimal digits; values are O(100).
+    np.testing.assert_allclose(T_got, T_ref, rtol=0.05, atol=0.5)
+
+
 def test_fused_zpatch_periodic_z_matches_xla():
     """Same cadence on the periodic self-neighbor z config (1 device)."""
     from jax.experimental.pallas import tpu as pltpu
