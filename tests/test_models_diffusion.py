@@ -362,10 +362,12 @@ def test_fused_zpatch_periodic_z_multiblock_matches_xla():
 def test_fused_zpatch_periodic_z_bfloat16():
     """The z-patch/export cadence at bf16 (itemsize 2): packing, patch
     application, and export must be dtype-clean — compared against the XLA
-    bf16 path at bf16 accuracy."""
+    bf16 path at bf16 accuracy.  nt=4 = two fused groups, so the second
+    group applies a REAL export-derived patch in-kernel (one group would
+    only ever apply the trivial identity patch)."""
     from jax.experimental.pallas import tpu as pltpu
 
-    nt = 2
+    nt = 4
     kw = dict(
         devices=jax.devices()[:1], periodz=1, overlapz=4, quiet=True,
         dtype=jax.numpy.bfloat16,
