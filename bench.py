@@ -56,7 +56,10 @@ def main():
     # (metric name unchanged from round 1 for comparability).  The XLA path
     # is the always-available fallback if the Pallas kernel fails on some
     # backend.
-    rec = _bench.bench_diffusion(n=256, chunk=24, reps=4, dtype="float32", emit=False)
+    # reps=5 (odd) on the two headline configs: the time-shared chip drifts
+    # ~10% between reps, and the recorded value is the per-rep median (odd
+    # rep counts make that the true middle sample, not the upper-median).
+    rec = _bench.bench_diffusion(n=256, chunk=24, reps=5, dtype="float32", emit=False)
     extras = {"diffusion_xla": {"teff": rec["value"], "t_it_ms": rec["t_it_ms"]}}
 
     def _extra(name, fn):
@@ -83,7 +86,7 @@ def main():
 
     def _fused():
         r = _bench.bench_diffusion(
-            n=256, chunk=24, reps=4, dtype="float32", emit=False, fused_k=4
+            n=256, chunk=24, reps=5, dtype="float32", emit=False, fused_k=4
         )
         return _fused_record(r)
 
