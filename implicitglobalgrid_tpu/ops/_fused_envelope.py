@@ -36,10 +36,11 @@ def vmem_budget(default_bytes: int) -> int:
 
     ``IGG_VMEM_MB`` declares the per-core VMEM capacity (MiB; the tuned
     defaults assume v5e's 128).  Each kernel's budget scales
-    proportionally, so the per-kernel headroom ratios stay intact (the
-    staggered kernels deliberately budget lower than the diffusion kernel —
-    Mosaic's scoped stack overshoots their buffer-byte estimate by ~18%;
-    a flat override would erase that margin).  jax's public API exposes no
+    proportionally, so the per-kernel headroom ratios stay intact (each
+    budget encodes that kernel's probed Mosaic scoped-stack overshoot over
+    the buffer-byte estimate — ~85% for the diffusion kernel, ~18% for the
+    staggered ones; a flat override would erase those margins).  jax's
+    public API exposes no
     per-generation VMEM size, so another generation tunes via env instead
     of editing source.  Read per envelope check, not at import, so tests
     and long-running processes can flip it.

@@ -77,11 +77,13 @@ from . import _fused_envelope as _envelope
 #: rank (VERDICT r3 #6).
 _TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 
-#: See `ops.pallas_stencil._VMEM_BUDGET_BYTES` (v5e-tuned module constant).
-#: Lower than the diffusion kernel's 100 MiB: Mosaic's real scoped-stack need
-#: exceeds the `_tile_bytes` estimate by ~18% for the 4-field set (probed:
-#: (32,128) k=6 estimated 92 MiB, Mosaic wanted 109 MiB), so the envelope
-#: rejects configs before they reach a Mosaic stack OOM.
+#: See `ops.pallas_stencil._VMEM_BUDGET_BYTES` (v5e-tuned estimate bound).
+#: Each kernel's budget encodes ITS probed Mosaic scoped-stack overshoot
+#: over the `_tile_bytes` estimate: ~18% for this 4-field set (probed:
+#: (32,128) k=6 estimated 92 MiB, Mosaic wanted 109 MiB) vs ~85% for the
+#: diffusion kernel's 5-buffer ping-pong — hence 85 MiB here against
+#: diffusion's 59.5.  The envelope rejects configs before they reach a
+#: Mosaic stack OOM.
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 

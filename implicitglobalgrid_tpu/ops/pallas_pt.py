@@ -49,7 +49,8 @@ from .pallas_leapfrog import (  # noqa: F401  (re-export)
 _TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 
 #: See `ops.pallas_leapfrog._VMEM_BUDGET_BYTES` (Mosaic's scoped stack runs
-#: ~18% past the buffer-byte estimate on the staggered sets).
+#: ~18% past the buffer-byte estimate on the staggered sets — the diffusion
+#: kernel's overshoot is far larger, hence its smaller budget).
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 

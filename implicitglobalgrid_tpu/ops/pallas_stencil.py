@@ -80,14 +80,19 @@ def _candidates(n2, k):
         return _TILE_CANDIDATES_DEEP_Z
     return _TILE_CANDIDATES
 
-#: VMEM the kernel may plan against.  v5e/v5p carry 128 MiB per core; 100 MiB
-#: leaves Mosaic's own margin.  Not a device query (jax's public API does not
-#: expose per-generation VMEM size): this is the v5e-tuned default, and a
-#: different generation declares its capacity via ``IGG_VMEM_MB``
-#: (`_fused_envelope.vmem_budget` scales every kernel's budget
-#: proportionally; auto-selection then grows/degrades through the candidate
-#: rungs, and `fused_support_error` keeps oversized explicit tiles out).
-_VMEM_BUDGET_BYTES = 100 * 1024 * 1024
+#: VMEM the kernel may plan against, as a `_tile_bytes` ESTIMATE bound.
+#: Mosaic's real scoped stack for this kernel runs ~1.85x the buffer-byte
+#: estimate (probed round 4: (32,64) k=4 at n2=1024 — estimate 65.5 MiB,
+#: Mosaic wanted 121.4 and OOM'd against the 110 MiB cap; the deep-z
+#: (32,128) k=4 at n2=512 — estimate 59 MiB — compiles, i.e. ~109 real),
+#: so the budget is 110/1.85 ~ 59.5 MiB: every estimate it admits fits the
+#: per-core cap after the overshoot.  Not a device query (jax's public API
+#: does not expose per-generation VMEM size): a different generation
+#: declares its capacity via ``IGG_VMEM_MB`` (`_fused_envelope.vmem_budget`
+#: scales every kernel's budget proportionally; auto-selection then
+#: grows/degrades through the candidate rungs, and `fused_support_error`
+#: keeps oversized explicit tiles out).
+_VMEM_BUDGET_BYTES = int(59.5 * 1024 * 1024)
 
 
 def _tile_bytes(n2, k, bx, by, itemsize, zslots: int = 0):

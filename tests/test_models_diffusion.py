@@ -180,6 +180,27 @@ def test_fused_fallback_warns_and_matches_xla():
     np.testing.assert_array_equal(T_fb, T_ref)
 
 
+def test_fused_complex_falls_back_and_matches():
+    """complex64 (itemsize 8) is outside the Mosaic envelope: fused_k must
+    warn once and run the XLA cadence, bit-identical to the per-step path
+    (the reference's dtype matrix includes complex; here the kernel lever
+    simply declines them instead of miscompiling)."""
+    kw = dict(overlapx=4, overlapy=4, overlapz=4, quiet=True,
+              dtype=jax.numpy.complex64)
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    step = diffusion3d.make_multi_step(params, 4, donate=False)
+    T_ref = np.asarray(igg.gather(jax.block_until_ready(step(*state))[0]))
+    igg.finalize_global_grid()
+
+    state, params = diffusion3d.setup(16, 32, 128, **kw)
+    with pytest.warns(RuntimeWarning, match="f64/complex"):
+        stepf = diffusion3d.make_multi_step(params, 4, donate=False, fused_k=2)
+        state = jax.block_until_ready(stepf(*state))
+    T_fb = np.asarray(igg.gather(state[0]))
+    igg.finalize_global_grid()
+    np.testing.assert_array_equal(T_fb, T_ref)
+
+
 def test_fused_requires_deep_halo():
     state, params = diffusion3d.setup(
         16, 32, 128, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1, quiet=True

@@ -220,10 +220,12 @@ def test_vmem_budget_env_override(monkeypatch):
         fused_support_error,
     )
 
-    # A 1024-deep volume: (32,64) needs ~57 MiB — fits the 100 MiB default.
+    # A 1024-deep volume: (32,64) at k=2 estimates ~56.3 MiB — just inside
+    # the 59.5 MiB default (the budget is an ESTIMATE bound; Mosaic's real
+    # ~1.85x overshoot is what the 59.5 encodes).
     assert default_tile((64, 128, 1024), 2) == (32, 64)
     monkeypatch.setenv("IGG_VMEM_MB", "64")
-    # Half the tuned capacity: budget 50 MiB, auto-selection degrades and
+    # Half the tuned capacity: budget ~29.8 MiB, auto-selection degrades and
     # oversized explicit tiles are rejected with the override in the message.
     assert default_tile((64, 128, 1024), 2) != (32, 64)
     err = fused_support_error((64, 128, 1024), 2, 4, 32, 64)
