@@ -275,11 +275,13 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     """
     global _barrier_fn
     check_initialized()
+    from ..ops import gather as _gather
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
 
     _halo._clear_caches()
     _stencil._clear_caches()
+    _gather._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
     if finalize_distributed:

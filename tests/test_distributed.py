@@ -30,7 +30,7 @@ def _free_port() -> int:
 
 
 @pytest.fixture(scope="module")
-def gathered_from_2proc(tmp_path_factory):
+def dist_out_path(tmp_path_factory):
     port = _free_port()
     out = str(tmp_path_factory.mktemp("dist") / "gathered.npy")
     env = dict(os.environ)
@@ -56,7 +56,7 @@ def gathered_from_2proc(tmp_path_factory):
     ]
     try:
         for pid, p in enumerate(procs):
-            p.wait(timeout=240)
+            p.wait(timeout=480)
     except subprocess.TimeoutExpired:
         for q in procs:
             q.kill()
@@ -72,10 +72,10 @@ def gathered_from_2proc(tmp_path_factory):
     for pid, rc, stdout in outs:
         assert rc == 0, f"worker {pid} failed (rc={rc}):\n{stdout}"
         assert f"WORKER {pid} OK" in stdout
-    return np.load(out)
+    return out
 
 
-def test_two_process_matches_single_process(gathered_from_2proc):
+def test_two_process_matches_single_process(dist_out_path):
     """The 2-process distributed run must reproduce the single-process run."""
     import implicitglobalgrid_tpu as igg
     from implicitglobalgrid_tpu.models import diffusion3d
@@ -89,9 +89,58 @@ def test_two_process_matches_single_process(gathered_from_2proc):
     expected = np.asarray(igg.gather(diffusion3d.temperature(state)))
     igg.finalize_global_grid()
 
-    got = gathered_from_2proc
+    got = np.load(dist_out_path)
     assert got.shape == expected.shape
     assert got.dtype == expected.dtype
+    np.testing.assert_allclose(got, expected, rtol=1e-13, atol=1e-13)
+
+
+def test_two_process_fused_cadence_matches_single_process(dist_out_path):
+    """The production fused cadence's COMMUNICATION across a REAL process
+    boundary (VERDICT r4 #3): the worker ran `make_multi_step(fused_k=2)` on
+    its f64 deep-halo grid — the documented fallback runs the XLA cadence at
+    the kernel path's exact exchange schedule (one width-2 slab exchange per
+    2 steps), with gloo hops inside every exchange.  The same problem with
+    the same decomposition single-process must agree bitwise-tight.  (The
+    Pallas kernel itself cannot cross a process boundary in interpret mode —
+    the interpreter barriers all global devices on local threads; see the
+    worker's comment — and its arithmetic equivalence to the XLA cadence is
+    pinned single-process in test_models_diffusion.py.)"""
+    import warnings
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    state, params = diffusion3d.setup(
+        NX, NX, NX, overlapx=4, overlapy=4, overlapz=4, quiet=True
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        stepc = diffusion3d.make_multi_step(params, 4, donate=False, fused_k=2)
+        state = jax.block_until_ready(stepc(*state))
+    expected = np.asarray(igg.gather(diffusion3d.temperature(state)))
+    igg.finalize_global_grid()
+
+    got = np.load(dist_out_path + ".fused.npy")
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-13, atol=1e-13)
+
+
+def test_two_process_hide_communication_matches_single_process(dist_out_path):
+    """`hide_communication` (overlap-scheduled exchange) across the real
+    process boundary, against the same 8-block problem single-process."""
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    state, params = diffusion3d.setup(NX, NX, NX, hide_comm=True, quiet=True)
+    step = diffusion3d.make_step(params, donate=False)
+    for _ in range(NSTEPS):
+        state = jax.block_until_ready(step(*state))
+    expected = np.asarray(igg.gather(diffusion3d.temperature(state)))
+    igg.finalize_global_grid()
+
+    got = np.load(dist_out_path + ".hc.npy")
+    assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-13, atol=1e-13)
 
 

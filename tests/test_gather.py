@@ -71,6 +71,85 @@ def test_gather_1d_2d():
     assert (g == 7).all()
 
 
+@pytest.mark.parametrize("use_out", [False, True])
+def test_gather_chunked_path_matches_local(use_out):
+    """The multi-host block-by-block assembly (`_gather_chunked`) against the
+    local path on the same field — pins the masked-psum fetch numerics and
+    block placement without a process boundary (the real boundary is covered
+    by tests/test_distributed.py)."""
+    from implicitglobalgrid_tpu.ops import gather as gather_mod
+
+    me, dims, nprocs, *_ = igg.init_global_grid(4, 4, 4, quiet=True)
+
+    def fill(coords):
+        cx, cy, cz = coords
+        r = (cx * dims[1] + cy) * dims[2] + cz
+        return (jnp.arange(64, dtype=jnp.float32).reshape(4, 4, 4) + 100.0 * r)
+
+    A = igg.from_block_fn(fill, (4, 4, 4), jnp.float32)
+    expect = igg.gather(A)
+    assert gather_mod.last_gather_stats["path"] == "local"
+    if use_out:
+        out = np.zeros(expect.shape, np.float32)
+        assert igg.gather(A, out, _force_chunked=True) is None
+        got = out
+    else:
+        got = igg.gather(A, _force_chunked=True)
+    stats = gather_mod.last_gather_stats
+    assert stats["path"] == "chunked"
+    assert stats["fetches"] == int(np.prod(dims))
+    assert stats["block_bytes"] == 64 * 4
+    # root (process 0 here) fetched exactly one block per collective — the
+    # per-process bound the reference's root-only design guarantees.
+    assert stats["host_bytes"] == stats["fetches"] * stats["block_bytes"]
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_gather_chunked_bit_exact_negative_zero():
+    """gather is a byte-copy in the reference (MPI); the chunked transport
+    bitcasts to integers around the psum so -0.0 survives (a float psum
+    would map -0.0 + 0.0 to +0.0)."""
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    A = igg.full((4, 4, 4), -0.0, "float64")
+    g = igg.gather(A, _force_chunked=True)
+    assert np.signbit(g).all()
+
+
+def test_gather_chunked_size_mismatch_raises_after_collectives():
+    """An invalid A_global on the root must still raise — but only after the
+    root has participated in every fetch (non-roots would otherwise hang in
+    the first collective; single-process here pins the raise itself)."""
+    from implicitglobalgrid_tpu.ops import gather as gather_mod
+
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    A = igg.ones((4, 4, 4), "float64")
+    with pytest.raises(ValueError, match="nprocs"):
+        igg.gather(A, np.zeros((4, 4, 4)), _force_chunked=True)
+    # the collectives all ran before the raise
+    gg = igg.get_global_grid()
+    assert gather_mod.last_gather_stats["fetches"] == int(np.prod(gg.dims))
+    assert gather_mod.last_gather_stats["host_bytes"] == 0
+
+
+def test_gather_chunked_2d_and_staggered():
+    from implicitglobalgrid_tpu.ops import gather as gather_mod
+
+    igg.init_global_grid(4, 4, 1, quiet=True)
+    gg = igg.get_global_grid()
+    A = igg.full((4, 4), 7, "float64")
+    got = igg.gather(A, _force_chunked=True)
+    assert gather_mod.last_gather_stats["path"] == "chunked"
+    assert got.shape == (gg.dims[0] * 4, gg.dims[1] * 4)
+    assert got.dtype == np.float64
+    assert (got == 7).all()
+    # staggered (nx+1) field: block shape from the shape-aware local_shape
+    B = igg.from_block_fn(
+        lambda c: jnp.full((5, 4), 1.0, jnp.float32) * c[0], (5, 4), jnp.float32
+    )
+    gotB = igg.gather(B, _force_chunked=True)
+    np.testing.assert_array_equal(gotB, igg.gather(B))
+
+
 def test_gather_after_block_slice():
     # the reference idiom: strip the halo locally, then gather
     igg.init_global_grid(4, 4, 4, quiet=True)
