@@ -15,27 +15,44 @@ from __future__ import annotations
 _warned: set = set()
 
 
-def run_group_schedule(chunks, body, carry, *, unroll_limit=8):
+def run_group_schedule(chunks, body, carry, *, unroll_limit=8,
+                       fori_excess_only=True):
     """Run ``carry = body(ki, carry)`` for each ``ki`` in ``chunks``.
 
-    The one loop shape behind every fused cadence's group sequence: a
-    leading run of equal chunks longer than ``unroll_limit`` goes through
-    ONE `lax.fori_loop` (bounds compile size for long schedules); the rest
-    is Python-unrolled — one Pallas call per group is tiny HLO, and the
-    unrolled form measured ~15-30% faster than a fori_loop over groups
-    (XLA pipelines DMAs across group boundaries; probed on v5e: porous
-    npt=12 fused6 788 -> 1017 GB/s/PT-iter, acoustic 256^3 fused6
-    1117 -> 1564).
+    The one loop shape behind every fused cadence's group sequence: up to
+    ``unroll_limit`` groups are Python-unrolled — one Pallas call per group
+    is tiny HLO, and the unrolled form measured ~15-30% faster than a
+    fori_loop over groups (XLA pipelines DMAs across group boundaries;
+    probed on v5e: porous npt=12 fused6 788 -> 1017 GB/s/PT-iter, acoustic
+    256^3 fused6 1117 -> 1564).  A leading run of equal chunks longer than
+    the limit routes only its EXCESS through ONE `lax.fori_loop` (bounds
+    compile size for long schedules) and still unrolls ``unroll_limit``
+    groups in total — a 12-group production schedule keeps the pipelining
+    win on 8 of them (advisor r4: the old shape sent such schedules
+    entirely through the fori_loop).
+
+    ``fori_excess_only=False`` restores the all-or-nothing shape: a uniform
+    run longer than the limit goes ENTIRELY through the fori_loop (the
+    ragged tail still unrolls).  The porous XLA cadence needs this — its
+    group bodies are large unrolled XLA programs whose bit-identity across
+    cadences relies on the fori boundary as a fusion barrier (unrolling the
+    last group lets XLA contract FMAs differently per surrounding context);
+    the Pallas paths are immune (fusion cannot reach inside a kernel).
     """
     prefix = 0
     while prefix < len(chunks) and chunks[prefix] == chunks[0]:
         prefix += 1
-    if prefix > unroll_limit:
+    if fori_excess_only:
+        keep = max(unroll_limit - (len(chunks) - prefix), 0)
+    else:
+        keep = prefix if prefix <= unroll_limit else 0
+    if prefix > keep:
         from jax import lax
 
         k0 = chunks[0]
-        carry = lax.fori_loop(0, prefix, lambda i, c: body(k0, c), carry)
-        chunks = chunks[prefix:]
+        nloop = prefix - keep
+        carry = lax.fori_loop(0, nloop, lambda i, c: body(k0, c), carry)
+        chunks = chunks[nloop:]
     for ki in chunks:
         carry = body(ki, carry)
     return carry

@@ -419,15 +419,19 @@ def make_multi_step(
             # The small ki-iteration body is unrolled inside each group (a
             # nested fori_loop is the measured-slow shape); the group
             # sequence runs through `run_group_schedule` with unroll_limit=1
-            # — unlike the one-Pallas-call fused groups, each XLA group is a
-            # large unrolled body, so any uniform run longer than one group
-            # stays a fori_loop to bound HLO size.
+            # and the all-or-nothing shape — unlike the one-Pallas-call
+            # fused groups, each XLA group is a large unrolled body, so any
+            # uniform run longer than one group stays a fori_loop to bound
+            # HLO size AND to keep the fori fusion barrier that makes this
+            # cadence bit-identical to the per-iteration path.
             def group(ki, s):
                 for _ in range(ki):
                     s = pt_iterate(T, s)
                 return update_halo(*s, width=w)
 
-            s = run_group_schedule(sched, group, s, unroll_limit=1)
+            s = run_group_schedule(
+                sched, group, s, unroll_limit=1, fori_excess_only=False
+            )
             Pf, qDx, qDy, qDz = s
             T = t_update(T, qDx, qDy, qDz)
             T = update_halo(T)

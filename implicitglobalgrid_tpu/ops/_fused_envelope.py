@@ -95,10 +95,17 @@ def make_tile_error(tile_bytes, budget, desc):
         vmem_need = tile_bytes(n2, k, bx, by, itemsize)
         live_budget = vmem_budget(budget)
         if vmem_need > live_budget:
+            # Name the env knob accurately: "scaled by" only when an override
+            # is actually active (advisor r4).
+            how = (
+                "scaled by IGG_VMEM_MB"
+                if os.environ.get("IGG_VMEM_MB")
+                else "tunable via IGG_VMEM_MB"
+            )
             return (
                 f"tile ({bx},{by}) with k={k} needs ~{vmem_need >> 20} MiB of "
-                f"VMEM ({desc}; budget {live_budget >> 20} MiB, scaled by "
-                "IGG_VMEM_MB); shrink the tile or k"
+                f"VMEM ({desc}; budget {live_budget >> 20} MiB, {how}); "
+                "shrink the tile or k"
             )
         if n0 % bx != 0 or n1 % by != 0:
             return f"tile ({bx},{by}) does not divide volume ({n0},{n1})"

@@ -600,16 +600,33 @@ def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1, dims=None):
     return tuple(out)
 
 
-def _global_update_fn(gg, shapes_dtypes, width: int = 1):
+def _default_donate() -> bool:
+    """``IGG_DONATE`` env default for `update_halo`'s global-array entry.
+
+    Donation makes the exchange buffer-in-place like the reference's mutating
+    API (no extra allocation) and is the right default on production
+    runtimes; some runtimes pay a large runtime-side penalty for donated
+    buffers (the tunneled single-chip bench backend measures ~3x,
+    docs/performance.md) — ``IGG_DONATE=0`` turns it off globally, the
+    per-call ``donate=`` kwarg overrides both.
+    """
+    from ..utils.config import _int_env
+
+    val = _int_env("IGG_DONATE")
+    return True if val is None else val > 0
+
+
+def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True):
     """Build (and cache) the jitted shard_map wrapper for one field signature."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    key = (gg.epoch, shapes_dtypes, width)
+    key = (gg.epoch, shapes_dtypes, width, donate)
     fn = _jit_cache.get(key)
     if fn is not None:
         return fn
     ndims_per_field = tuple(len(s) for s, _ in shapes_dtypes)
+    dn = tuple(range(len(ndims_per_field))) if donate else ()
 
     def exchange(*fields):
         return _update_halo_local(fields, gg, width)
@@ -617,7 +634,7 @@ def _global_update_fn(gg, shapes_dtypes, width: int = 1):
     if gg.nprocs == 1 and not gg.force_spmd:
         # 1-device grid: only self-neighbor local copies remain (no ppermute,
         # no axis environment) — plain jit avoids the SPMD execution path.
-        fn = jax.jit(exchange, donate_argnums=tuple(range(len(ndims_per_field))))
+        fn = jax.jit(exchange, donate_argnums=dn)
         _jit_cache[key] = fn
         return fn
 
@@ -625,26 +642,33 @@ def _global_update_fn(gg, shapes_dtypes, width: int = 1):
     mapped = jax.shard_map(
         exchange, mesh=gg.mesh, in_specs=specs, out_specs=specs, check_vma=False
     )
-    fn = jax.jit(mapped, donate_argnums=tuple(range(len(specs))))
+    fn = jax.jit(mapped, donate_argnums=dn)
     _jit_cache[key] = fn
     return fn
 
 
-def update_halo(*fields, width: int = 1):
+def update_halo(*fields, width: int = 1, donate: bool | None = None):
     """Update the halo planes of the given field(s).
 
     TPU-native counterpart of `update_halo!` (`/root/reference/src/update_halo.jl:25-78`).
     Functional: returns the updated field(s) — a single array for one argument,
     a tuple for several.  Pass all fields of a time step in one call so XLA
     compiles one fused program (the reference's pipelining advice,
-    `/root/reference/src/update_halo.jl:13-14`); inputs are donated, so the
-    update is buffer-in-place like the reference's mutating API.
+    `/root/reference/src/update_halo.jl:13-14`).
 
     ``width``: halo planes refreshed per side (default 1 = the reference's
     exchange).  ``width=w`` on a deep-halo grid (``overlap >= 2w``) refreshes
     ``w`` planes in one collective, licensing ``w`` stencil steps between
     exchanges (temporal blocking, `make_multi_step(fused_k=w)`): the
     per-hop latency of the exchange amortizes over ``w`` steps.
+
+    ``donate`` (global-array calls only): donate the inputs so the update is
+    buffer-in-place like the reference's mutating API.  Default from the
+    ``IGG_DONATE`` env var, else True; pass ``donate=False`` (or set
+    ``IGG_DONATE=0``) on runtimes where donation is slow — the tunneled
+    single-chip bench backend measures ~3x (docs/performance.md) — or when
+    the caller reuses the passed-in arrays.  Inside a traced context the
+    flag is ignored: buffer lifetime belongs to the enclosing program.
     """
     import jax
 
@@ -675,5 +699,7 @@ def update_halo(*fields, width: int = 1):
                 A = jax.device_put(np.asarray(A), NamedSharding(gg.mesh, spec))
             arrs.append(A)
         sig = tuple((local_shape(A, gg), str(A.dtype)) for A in arrs)
-        out = _global_update_fn(gg, sig, width)(*arrs)
+        if donate is None:
+            donate = _default_donate()
+        out = _global_update_fn(gg, sig, width, bool(donate))(*arrs)
     return out[0] if len(fields) == 1 else tuple(out)

@@ -16,6 +16,12 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_REORDER``           default mesh reorder flag (ICI-torus alignment)
 ``IGG_OVERLAP``           default overlap in every dimension (reference
                           kwarg ``overlapx/y/z`` default 2)
+``IGG_DONATE``            default for `update_halo`'s global-array buffer
+                          donation (0 = off; see `ops.halo._default_donate`
+                          — read per call, not at init)
+``IGG_VMEM_MB``           per-core VMEM capacity the fused kernels plan
+                          against (`ops._fused_envelope.vmem_budget` — read
+                          per kernel build, not at init)
 ========================  ====================================================
 
 Explicit `init_global_grid` kwargs always win over env values; env values win

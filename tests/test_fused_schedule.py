@@ -1,0 +1,87 @@
+"""Group-schedule loop shape (`models/_fused.run_group_schedule`) and the
+deep-z envelope gate coupling (`ops/pallas_stencil`) — advisor r4 findings."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from implicitglobalgrid_tpu.models._fused import run_group_schedule
+from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
+
+
+def _traced(chunks):
+    """Run a schedule under jit; return (result, body trace count)."""
+    calls = []
+
+    def body(ki, c):
+        calls.append(ki)
+        return c + ki
+
+    out = jax.jit(lambda c: run_group_schedule(chunks, body, c))(jnp.float32(0))
+    return float(out), len(calls)
+
+
+def test_short_schedule_fully_unrolled():
+    out, ncalls = _traced([2] * 5)
+    assert out == 10.0
+    assert ncalls == 5  # no fori_loop at all
+
+
+def test_long_uniform_schedule_keeps_unrolled_groups():
+    """A 12-group production schedule must keep the unrolled-group pipelining
+    win on unroll_limit groups, fori-looping only the excess (advisor r4:
+    the old shape sent the whole run through the fori_loop, silently losing
+    the documented 15-30% speedup for nsteps=24 at fused_k=2)."""
+    out, ncalls = _traced([2] * 12)
+    assert out == 24.0
+    # 8 unrolled traces + the fori body trace(s); strictly fewer than full
+    # unroll, strictly more than fori-only (1-2 traces).
+    assert 9 <= ncalls <= 10
+
+
+def test_ragged_schedule_counts_tail_against_limit():
+    out, ncalls = _traced([6] * 10 + [4])
+    assert out == 64.0
+    # 7 unrolled prefix + 1 ragged tail + fori trace(s)
+    assert 9 <= ncalls <= 10
+
+
+def test_all_or_nothing_shape_for_xla_cadences():
+    """`fori_excess_only=False` (the porous XLA cadence): a uniform run past
+    the limit is ENTIRELY fori-looped — the fori boundary is the fusion
+    barrier its bit-identity contract relies on — while a ragged tail and
+    within-limit runs still unroll."""
+    calls = []
+
+    def body(ki, c):
+        calls.append(ki)
+        return c + ki
+
+    out = jax.jit(
+        lambda c: run_group_schedule(
+            [2] * 3, body, c, unroll_limit=1, fori_excess_only=False
+        )
+    )(jnp.float32(0))
+    assert float(out) == 6.0
+    assert len(calls) <= 2  # fori trace only, no unrolled groups
+    calls.clear()
+    out = jax.jit(
+        lambda c: run_group_schedule(
+            [6, 4], body, c, unroll_limit=1, fori_excess_only=False
+        )
+    )(jnp.float32(0))
+    assert float(out) == 10.0
+    assert calls == [6, 4]  # prefix of one group: fully unrolled
+
+
+def test_deep_z_gate_and_budget_jointly_cover_by128():
+    """Advisor r4: the probed crash predicate (`_deep_z_crash`: by>=128, k>4,
+    n2>=512) and the VMEM budget are coupled — by=128 configs the predicate
+    admits (k <= 4) at deep z must be stopped by the budget instead.  Pin
+    every by=128 deep-z combination to a rejection by ONE of the two gates,
+    and the probed-safe point to acceptance."""
+    for k, n2 in [(2, 1024), (4, 1024), (6, 512), (6, 1024)]:
+        err = fused_support_error((64, 256, n2), k, 4, 32, 128)
+        assert err is not None, f"(k={k}, n2={n2}) must be rejected"
+    # the hardware-validated deep-z rung stays in the envelope
+    assert fused_support_error((64, 256, 512), 4, 4, 32, 128) is None
