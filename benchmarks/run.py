@@ -138,19 +138,26 @@ def _time_steps(step, state, chunk: int, reps: int):
     return t_it, state, spread
 
 
-def _fused_provenance(fused_k, support_error, local_shape, itemsize, fused_tile):
+def _fused_provenance(fused_k, support_error, local_shape, itemsize, fused_tile,
+                      z_active=False):
     """Metric suffix + path record for a ``fused_k`` request.
 
-    Deterministic provenance (same envelope check the model's fallback
+    Deterministic provenance (same envelope checks the model's fallback
     uses): a config the kernel envelope rejects ran the warn-once XLA
     cadence, and the emitted metric name must say so — otherwise an XLA
-    number gets recorded under a fused-kernel label.
+    number gets recorded under a fused-kernel label.  ``z_active`` mirrors
+    the model's path selection: on z-communicating grids the z-patch
+    envelope is consulted first (it admits full-y tiles the plain envelope
+    does not, and vice versa at large volumes).
     """
     if not fused_k:
         return "", None
     bx, by = fused_tile if fused_tile is not None else (None, None)
-    err = support_error(tuple(local_shape), fused_k, itemsize, bx, by)
-    if err is None:
+    shape = tuple(local_shape)
+    ok = support_error(shape, fused_k, itemsize, bx, by) is None
+    if z_active and not ok:
+        ok = support_error(shape, fused_k, itemsize, bx, by, zpatch=True) is None
+    if ok:
         return f"_fused{fused_k}", "pallas-fused"
     return f"_fused{fused_k}fb", "xla-fallback"
 
@@ -212,9 +219,12 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
     )
     from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
 
+    from implicitglobalgrid_tpu.ops.halo import dim_has_halo_activity
+
     fsuf, fpath = _fused_provenance(
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
+        z_active=dim_has_halo_activity(igg.get_global_grid(), 2),
     )
     t_it, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
@@ -260,9 +270,12 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
     )
     from implicitglobalgrid_tpu.ops.pallas_leapfrog import fused_support_error
 
+    from implicitglobalgrid_tpu.ops.halo import dim_has_halo_activity
+
     fsuf, fpath = _fused_provenance(
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
+        z_active=dim_has_halo_activity(igg.get_global_grid(), 2),
     )
     t_it, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
@@ -309,9 +322,12 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     )
     from implicitglobalgrid_tpu.ops.pallas_pt import fused_support_error
 
+    from implicitglobalgrid_tpu.ops.halo import dim_has_halo_activity
+
     fsuf, fpath = _fused_provenance(
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
+        z_active=dim_has_halo_activity(igg.get_global_grid(), 2),
     )
     t_step, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()

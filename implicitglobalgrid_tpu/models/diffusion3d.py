@@ -342,13 +342,23 @@ def make_multi_step(
         def fused_zpatch_step(T, Cp):
             from ..ops.halo import (
                 apply_z_patch,
+                apply_z_patch_t,
                 exchange_dims,
+                exchange_dims_t,
                 identity_z_patch,
+                identity_z_patch_t,
                 ol,
                 z_patch_from_export,
+                z_patch_from_export_t,
             )
+            from ..ops.pallas_stencil import zpatch_transposed
 
-            o_z = ol(2, shape=tuple(T.shape), gg=gg)
+            shape = tuple(T.shape)
+            o_z = ol(2, shape=shape, gg=gg)
+            # Patch layout follows the kernel's tile choice: full-y tiles
+            # take the transposed thin-plane layout (round 5 — ~16x less
+            # patch/export window traffic), others the packed 128-lane one.
+            tr = zpatch_transposed(shape, fused_k, T.dtype.itemsize, bx, by)
 
             def group(ki, carry):
                 T, patch = carry
@@ -363,13 +373,18 @@ def make_multi_step(
                     z_export=True, z_overlap=o_z,
                 )
                 T = exchange_dims(T, (0, 1), width=fused_k)
+                if tr:
+                    zex = exchange_dims_t(zex, width=fused_k, shape=shape)
+                    return T, z_patch_from_export_t(zex, width=fused_k)
                 zex = exchange_dims(zex, (0, 1), width=fused_k)
                 return T, z_patch_from_export(zex, width=fused_k)
 
+            mk_ident = identity_z_patch_t if tr else identity_z_patch
             T, patch = run_group_schedule(
-                groups, group, (T, identity_z_patch(T, width=fused_k))
+                groups, group, (T, mk_ident(T, width=fused_k))
             )
-            return apply_z_patch(T, patch, width=fused_k), Cp
+            mk_apply = apply_z_patch_t if tr else apply_z_patch
+            return mk_apply(T, patch, width=fused_k), Cp
 
         def xla_cadence_step(T, Cp):
             def group(i, T):

@@ -27,6 +27,16 @@ def aligned_halo(k: int) -> int:
     return 8 * math.ceil(k / 8)
 
 
+def pad8(x: int) -> int:
+    """Round up to sublane alignment (the transposed-layout plane pad)."""
+    return -(-x // 8) * 8
+
+
+def pad128(x: int) -> int:
+    """Round up to lane-tile alignment (minor-dim extents of HBM arrays)."""
+    return -(-x // 128) * 128
+
+
 #: Per-core VMEM the tuned defaults were probed against (v5e/v5p: 128 MiB).
 _TUNED_VMEM_MB = 128
 
@@ -80,19 +90,27 @@ def pick_tile_error(base, patch, export, zpatch, zexport=None):
     return patch if zpatch else base
 
 
-def make_tile_error(tile_bytes, budget, desc):
+def make_tile_error(tile_bytes, budget, desc, full_y_ok=False):
     """Build a kernel's ``tile_error`` from its VMEM accounting.
 
-    ``tile_bytes(n2, k, bx, by, itemsize)`` is the kernel-specific working
-    set; ``budget`` its tuned default budget (env-overridable, see
+    ``tile_bytes(n1, n2, k, bx, by, itemsize)`` is the kernel-specific
+    working set (``n1`` matters only to the full-y window modes);
+    ``budget`` its tuned default budget (env-overridable, see
     `vmem_budget`); ``desc`` names it in the rejection message.  Everything
     else (divisibility, sublane alignment, haloed-tile fit) is
     kernel-independent and lives here once.
+
+    ``full_y_ok``: admit ``by == n1`` full-y tiles (window spans all of y
+    with NO y halo — the window edge is the block edge, where the frozen
+    ring reproduces the XLA cadence's own frozen boundary, so no recompute
+    halo is needed).  Only for kernels whose window math implements the
+    mode (round 5: the diffusion kernel); others keep rejecting oversized
+    windows.
     """
 
     def tile_error(n0, n1, n2, k, bx, by, itemsize):
-        H = aligned_halo(k)
-        vmem_need = tile_bytes(n2, k, bx, by, itemsize)
+        H = 0 if (full_y_ok and by == n1) else aligned_halo(k)
+        vmem_need = tile_bytes(n1, n2, k, bx, by, itemsize)
         live_budget = vmem_budget(budget)
         if vmem_need > live_budget:
             # Name the env knob accurately: "scaled by" only when an override

@@ -206,7 +206,7 @@ def _get_plane(A, index: int, dim: int, width: int = 1):
     return lax.slice_in_dim(A, index, index + width, axis=dim)
 
 
-def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
+def _exchange_dim(A, d: int, gg, width: int = 1, logical=None, axis=None) -> "jax.Array":
     """Exchange the two halo slabs (``width`` planes each) of block ``A``
     along dimension ``d``.
 
@@ -226,18 +226,23 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None) -> "jax.Array":
     The pad tail is junk by the layout's contract, so exchanging junk
     planes along *other* dimensions (full-extent slabs include the tail)
     is harmless.
+
+    ``axis``: the ARRAY axis holding grid dimension ``d``'s data when the
+    two differ (transposed patch layouts store y on axis 2); slab indices
+    still come from ``logical[d]``, the field's real size in grid dim ``d``.
     """
-    vals = _slab_recv_values(A, d, gg, width, logical)
+    vals = _slab_recv_values(A, d, gg, width, logical, axis=axis)
     if vals is None:
         return A
     lo_vals, hi_vals = vals
     shp = logical if logical is not None else tuple(A.shape)
-    A = _set_plane(A, hi_vals, shp[d] - width, d)
-    A = _set_plane(A, lo_vals, 0, d)
+    ax = d if axis is None else axis
+    A = _set_plane(A, hi_vals, shp[d] - width, ax)
+    A = _set_plane(A, lo_vals, 0, ax)
     return A
 
 
-def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
+def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None):
     """The two slabs a ``d``-exchange of ``A`` would write, without writing.
 
     Returns ``(lo_vals, hi_vals)`` — the values destined for planes
@@ -252,6 +257,7 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
     from jax import lax
 
     shp = logical if logical is not None else tuple(A.shape)  # local block shape
+    ax = d if axis is None else axis  # array axis carrying grid dim d's data
     if d >= len(shp):
         # A dimension beyond the field's rank can only ever be exchanged with a
         # self/absent neighbor (grid validation forces dims[d]==1, period 0).
@@ -287,8 +293,8 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
         # reference's self-neighbor fast path generalized, or disp==0):
         # pure local copy (reference: update_halo.jl:57-63).
         return (
-            _get_plane(A, n - o, d, width),      # -> planes [0, width)
-            _get_plane(A, o - width, d, width),  # -> planes [n-width, n)
+            _get_plane(A, n - o, ax, width),      # -> planes [0, width)
+            _get_plane(A, o - width, ax, width),  # -> planes [n-width, n)
         )
 
     # Slabs go to the lower partner's top ``width`` planes / the upper
@@ -296,10 +302,10 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None):
     # generalized from one plane to a slab).
     return _permute_slabs(
         gg, d,
-        send_lo=_get_plane(A, o - width, d, width),
-        send_hi=_get_plane(A, n - o, d, width),
-        keep_lo=lambda: _get_plane(A, 0, d, width),
-        keep_hi=lambda: _get_plane(A, n - width, d, width),
+        send_lo=_get_plane(A, o - width, ax, width),
+        send_hi=_get_plane(A, n - o, ax, width),
+        keep_lo=lambda: _get_plane(A, 0, ax, width),
+        keep_hi=lambda: _get_plane(A, n - width, ax, width),
     )
 
 
@@ -418,6 +424,95 @@ def apply_z_patch(A, patch, *, width: int = 1):
     n = A.shape[2]
     A = _set_plane(A, patch[:, :, :width], 0, 2)
     return _set_plane(A, patch[:, :, width : 2 * width], n - width, 2)
+
+
+# --- Transposed thin-patch layout (round 5) ---------------------------------
+#
+# The packed 128-lane z-patch layout moves 128 lanes per window for 2k-4k
+# real planes — at n2=256 the patch/export windows cost the fused z-split
+# cadence ~30% extra HBM traffic (VERDICT r4 missing #3).  The transposed
+# layout stores the thin dimension in SUBLANES instead: a patch is
+# ``(n0, pad8(planes), n1p)`` with plane p of the field's y-row at
+# ``[:, p, :]`` — sublanes are 8-dense, so windows move pad8(2k) planes
+# instead of 128 lanes (~16x less patch traffic), and the export write
+# shrinks the same way.  The kernel needs FULL-Y tiles (``by == n1``) for
+# this layout: the transposed export's out-DMA then has no minor-dim window
+# offsets at all (minor-dim slicing would need 128-aligned offsets the
+# owned-block geometry cannot provide).  Plane layout along axis 1 is
+# identical to the packed layout's lanes: patches [0,w) = values for planes
+# [0,w), [w,2w) = the top w planes; exports [0,w) send-hi, [w,2w) send-lo,
+# [2w,3w)/[3w,4w) keep-old.  ``n1p`` pads the minor (y) extent to a 128
+# multiple (Mosaic lane-tile alignment).
+
+from ._fused_envelope import pad8 as _pad8, pad128 as _pad128
+
+
+def _pack_z_patch_t(lo, hi, width: int):
+    """Pack two z slabs (each ``(n0, n1, width)``) into the transposed patch
+    ``(n0, pad8(2w), pad128(n1))``."""
+    import jax.numpy as jnp
+
+    packed = jnp.concatenate([lo, hi], axis=2).transpose(0, 2, 1)
+    n0, p, n1 = packed.shape
+    return jnp.pad(packed, ((0, 0), (0, _pad8(p) - p), (0, _pad128(n1) - n1)))
+
+
+def identity_z_patch_t(A, *, width: int = 1):
+    """Transposed-layout `identity_z_patch` (re-writes the current z planes)."""
+    n = A.shape[2]
+    return _pack_z_patch_t(
+        _get_plane(A, 0, 2, width), _get_plane(A, n - width, 2, width), width
+    )
+
+
+def apply_z_patch_t(A, patch_t, *, width: int = 1):
+    """Transposed-layout `apply_z_patch` (the chunk-end restoration)."""
+    n0, n1, n = A.shape
+    lo = patch_t[:, 0:width, :n1].transpose(0, 2, 1)
+    hi = patch_t[:, width : 2 * width, :n1].transpose(0, 2, 1)
+    A = _set_plane(A, lo, 0, 2)
+    return _set_plane(A, hi, n - width, 2)
+
+
+def exchange_dims_t(E, *, width: int, shape):
+    """x/y-exchange a TRANSPOSED z-patch/export array ``(n0, P, n1p)``.
+
+    Grid dim 0's slabs live on array axis 0 (as usual); grid dim 1's live on
+    array axis 2, with slab indices from the field's REAL shape ``shape`` —
+    the ``axis`` override of `_exchange_dim`.  Dimension order (x before y)
+    carries the sequential-dimension corner semantics exactly like the
+    packed layout's `exchange_dims`.
+    """
+    gg = _grid.global_grid()
+    E = _exchange_dim(E, 0, gg, width, logical=shape, axis=0)
+    return _exchange_dim(E, 1, gg, width, logical=shape, axis=2)
+
+
+def z_patch_from_export_t(export_t, *, width: int):
+    """Transposed-layout `z_patch_from_export`: the z communication on the
+    ``(n0, PE, n1p)`` export's axis-1 plane slabs.  Must run AFTER the x/y
+    exchange of the export (`exchange_dims_t`)."""
+    import jax.numpy as jnp
+
+    gg = _grid.global_grid()
+    w = width
+    if _partner_self(gg, 2):
+        # Planes [0,2w) are already the patch (send-hi -> planes [0,w),
+        # send-lo -> the top w planes); the pad8 tail planes are junk either
+        # way, so hand the export straight back when the pads agree.
+        if _pad8(2 * w) == export_t.shape[1]:
+            return export_t
+        return export_t[:, 0 : _pad8(2 * w), :]
+    recv_lo, recv_hi = _permute_slabs(
+        gg, 2,
+        send_lo=export_t[:, w : 2 * w, :],
+        send_hi=export_t[:, 0:w, :],
+        keep_lo=lambda: export_t[:, 2 * w : 3 * w, :],
+        keep_hi=lambda: export_t[:, 3 * w : 4 * w, :],
+    )
+    packed = jnp.concatenate([recv_lo, recv_hi], axis=1)
+    pad = _pad8(2 * w) - 2 * w
+    return jnp.pad(packed, ((0, 0), (0, pad), (0, 0)))
 
 
 def exchange_dims(A, dims, *, width: int = 1, logical=None):

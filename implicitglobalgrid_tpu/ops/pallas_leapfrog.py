@@ -87,9 +87,10 @@ _TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize, zsets: int = 0):
+def _tile_bytes(n1, n2, k, bx, by, itemsize, zsets: int = 0):
     """VMEM bytes for one full ping-pong set (4 fields x (2 slots + scratch)).
 
+    ``n1`` is unused (this kernel has no full-y mode — envelope signature).
     ``zsets``: how many four-field double-buffered 128-lane window sets to
     add (1 = the z-patch input windows, 2 = + the z-export staging slots)."""
     H = _envelope.aligned_halo(k)
@@ -111,12 +112,12 @@ _tile_error = _envelope.make_tile_error(
     _tile_bytes, _VMEM_BUDGET_BYTES, "12 haloed staggered tiles spanning z"
 )
 _tile_error_zpatch = _envelope.make_tile_error(
-    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 1),
+    lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 1),
     _VMEM_BUDGET_BYTES,
     "12 haloed staggered tiles spanning z + 8 z-patch windows",
 )
 _tile_error_zexport = _envelope.make_tile_error(
-    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 2),
+    lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 2),
     _VMEM_BUDGET_BYTES,
     "12 haloed staggered tiles spanning z + z-patch windows + export staging",
 )
@@ -644,7 +645,7 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
             )
         pl.run_scoped(body, **scopes)
 
-    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, (2 if zx else 1) if zp else 0)
+    vmem_bytes = _tile_bytes(n1, n2, k, bx, by, dt_.itemsize, (2 if zx else 1) if zp else 0)
     out_shape = [
         jax.ShapeDtypeStruct((n0, n1, n2), dt_),
         jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),

@@ -54,7 +54,7 @@ _TILE_CANDIDATES = ((32, 64), (32, 32), (16, 64), (16, 32), (8, 16))
 _VMEM_BUDGET_BYTES = 85 * 1024 * 1024
 
 
-def _tile_bytes(n2, k, bx, by, itemsize, zsets: int = 0):
+def _tile_bytes(n1, n2, k, bx, by, itemsize, zsets: int = 0):
     """VMEM bytes: 4 ping-pong fields x (2 slots + scratch) + 2 T slots
     plus ``zsets`` four-field double-buffered 128-lane window sets (1 = the
     z-patch input windows, 2 = + the z-export staging slots)."""
@@ -77,12 +77,12 @@ _tile_error = _envelope.make_tile_error(
     _tile_bytes, _VMEM_BUDGET_BYTES, "14 haloed staggered tiles spanning z"
 )
 _tile_error_zpatch = _envelope.make_tile_error(
-    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 1),
+    lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 1),
     _VMEM_BUDGET_BYTES,
     "14 haloed staggered tiles spanning z + 8 z-patch windows",
 )
 _tile_error_zexport = _envelope.make_tile_error(
-    lambda n2, k, bx, by, itemsize: _tile_bytes(n2, k, bx, by, itemsize, 2),
+    lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 2),
     _VMEM_BUDGET_BYTES,
     "14 haloed staggered tiles spanning z + z-patch windows + export staging",
 )
@@ -548,7 +548,7 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
             )
         pl.run_scoped(body, **scopes)
 
-    vmem_bytes = _tile_bytes(n2, k, bx, by, dt_.itemsize, (2 if zx else 1) if zp else 0)
+    vmem_bytes = _tile_bytes(n1, n2, k, bx, by, dt_.itemsize, (2 if zx else 1) if zp else 0)
     out_shape = [
         jax.ShapeDtypeStruct((n0, n1, n2), dt_),
         jax.ShapeDtypeStruct((n0 + 8, n1, n2), dt_),

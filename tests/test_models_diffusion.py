@@ -294,15 +294,27 @@ def test_fused_zpatch_random_topology_invariance(seed):
     nloc = (n0, n1, 128)
     # (16,32) tiles need bx|n0 with the haloed window inside the block
     # (n0 >= 20) and by|n1 with SY=48 <= n1 — only the (32,64) draw.
+    # A by=n1 draw exercises the TRANSPOSED full-y patch layout (round 5);
+    # the others pin the packed 128-lane layout.
     big_ok = n0 == 32 and n1 == 64
-    tile = (16, 32) if big_ok and bool(rng.integers(2)) else (8, 16)
+    choice = int(rng.integers(3))
+    if choice == 0:
+        tile = (8, n1)  # full-y -> transposed layout
+    elif big_ok and choice == 1:
+        tile = (16, 32)
+    else:
+        tile = (8, 16)
 
-    from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
+    from implicitglobalgrid_tpu.ops.pallas_stencil import (
+        fused_support_error,
+        zpatch_transposed,
+    )
 
     # The oracle is only meaningful if the z-patch kernel path is actually
     # selected (f32: the envelope rejects f64) — guard against a silent
     # fall-back to the XLA cadence.
     assert fused_support_error(nloc, k, 4, *tile, zpatch=True) is None
+    assert zpatch_transposed(nloc, k, 4, *tile) == (tile[1] == n1)
 
     kw = dict(
         devices=jax.devices()[: dims[0] * dims[1] * dims[2]],

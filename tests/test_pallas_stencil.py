@@ -144,18 +144,22 @@ def test_auto_tile_fallback():
         fused_support_error,
     )
 
-    assert default_tile((64, 128, 128), 2) == (32, 64)
-    # 64 does not divide 96; the (32,32) rung (round 4) beats the old (16,32)
-    assert default_tile((96, 96, 128), 2) == (32, 32)
-    # Deep-z volumes lead with the (32,128) rung (measured +6% at 512^3) —
-    # k <= 4 only: the k=6 combination crashes the TPU compiler (probed),
-    # both in auto-selection and as an explicit tile.
+    # Full-y rungs lead when they fit (round 5: (32,n1) measured 976 vs
+    # (32,64)'s 444 GB/s at 256^3 k=4 — no y halo, lowest recompute
+    # redundancy).
+    assert default_tile((64, 128, 128), 2) == (32, 128)
+    assert default_tile((96, 96, 128), 2) == (32, 96)
+    # Deep-z volumes where full-y busts VMEM fall onto the (32,128)
+    # y-windowed rung (measured +6% over (32,64) at 512^3) — k <= 4 only:
+    # the k=6 combination crashes the TPU compiler (probed), both in
+    # auto-selection and as an explicit tile (the crash gate also disables
+    # the full-y rungs there: by=n1 >= 128).
     assert default_tile((64, 256, 512), 4) == (32, 128)
     assert default_tile((64, 256, 512), 6) == (32, 64)
     err = fused_support_error((64, 256, 512), 6, 4, 32, 128)
     assert err is not None and "crashes the TPU compiler" in err
-    assert default_tile((64, 128, 512), 4) == (32, 64)  # 128 < SY=144
-    assert default_tile((32, 64, 128), 2) == (16, 32)   # ncy=1 at by=64
+    assert default_tile((64, 128, 512), 4) == (32, 128)  # full-y fits here
+    assert default_tile((32, 64, 128), 2) == (16, 64)   # full-y, bx=16
     assert default_tile((16, 32, 128), 2) == (8, 16)  # too small for 16x32 halos
     assert default_tile((8, 8, 128), 2) is None
     # End-to-end: auto-picked tile matches k XLA steps.
@@ -220,18 +224,20 @@ def test_vmem_budget_env_override(monkeypatch):
         fused_support_error,
     )
 
-    # A 1024-deep volume: (32,64) at k=2 estimates ~56.3 MiB — just inside
-    # the 59.5 MiB default (the budget is an ESTIMATE bound; Mosaic's real
-    # ~1.85x overshoot is what the 59.5 encodes).
-    assert default_tile((64, 128, 1024), 2) == (32, 64)
+    # A 1024-deep volume: the (16,128) full-y rung estimates ~52.4 MiB —
+    # inside the 59.5 MiB default (the budget is an ESTIMATE bound; Mosaic's
+    # real ~1.85x overshoot is what the 59.5 encodes); the (32,128) full-y
+    # rung (~94 MiB) is out.
+    assert default_tile((64, 128, 1024), 2) == (16, 128)
     monkeypatch.setenv("IGG_VMEM_MB", "64")
     # Half the tuned capacity: budget ~29.8 MiB, auto-selection degrades and
     # oversized explicit tiles are rejected with the override in the message.
-    assert default_tile((64, 128, 1024), 2) != (32, 64)
+    assert default_tile((64, 128, 1024), 2) == (16, 32)
     err = fused_support_error((64, 128, 1024), 2, 4, 32, 64)
     assert err is not None and "IGG_VMEM_MB" in err
     monkeypatch.setenv("IGG_VMEM_MB", "256")
-    assert default_tile((64, 128, 1024), 2) == (32, 64)
+    # Doubled capacity re-admits the (32,128) full-y rung.
+    assert default_tile((64, 128, 1024), 2) == (32, 128)
     for bad in ("nope", "0", "-5"):
         monkeypatch.setenv("IGG_VMEM_MB", bad)
         with pytest.raises(ValueError, match="IGG_VMEM_MB"):
