@@ -2,7 +2,8 @@
 
 `ops/pallas_stencil.py` (cell-centered diffusion) and `ops/pallas_leapfrog.py`
 (staggered leapfrog) share every hardware-probed constraint except the VMEM
-accounting of their working sets: k even in [2, 6], minor dim <= 1024
+accounting of their working sets: k even in [2, 8] (k=8 since round 5, with
+the H=16 y-halo margin — see `aligned_halo`), minor dim <= 1024
 (validated ceiling) and a multiple of 128 (Mosaic requires lane-tile-aligned
 minor extents on HBM memref slices — probed at n2=192, round 3), y-size a
 multiple of 8 (sublane-aligned second-minor DMA windows), tuned-candidate
@@ -23,8 +24,14 @@ import os
 
 
 def aligned_halo(k: int) -> int:
-    """y-halo padded to sublane alignment: ``H = 8*ceil(k/8)``."""
-    return 8 * math.ceil(k / 8)
+    """y-halo: sublane-aligned with at least one spare ring beyond ``k`` —
+    ``H = 8*ceil((k+1)/8)`` (8 for k <= 6, 16 for k = 8).
+
+    The margin is load-bearing: k=8 with H=8 (halo exactly k, no spare
+    ring) corrupted tile-corner cells on this toolchain (probed round 3);
+    H=16 at k=8 is hardware-validated BITWISE against 8 XLA steps
+    (round 5 probe, acoustic 256^3 (32,64))."""
+    return 8 * math.ceil((k + 1) / 8)
 
 
 def pad8(x: int) -> int:
@@ -161,11 +168,11 @@ def support_error(shape, k, itemsize, bx, by, *, tile_error, candidates):
             f"itemsize {itemsize} (f64/complex) is not supported by TPU "
             "Pallas kernels; fall back to the XLA path (XLA emulates x64)"
         )
-    if k < 2 or k % 2 != 0 or k > 6:
+    if k < 2 or k % 2 != 0 or k > 8:
         return (
-            f"k must be even in [2, 6] (got {k}); use the XLA path for k=1. "
-            "k=8 needs a y-halo margin beyond the aligned 8 (validated to "
-            "corrupt tile-corner cells on this toolchain)"
+            f"k must be even in [2, 8] (got {k}); use the XLA path for k=1. "
+            "(k=8 runs with the H=16 y-halo margin — `aligned_halo`; deeper "
+            "blocking is unvalidated)"
         )
     if n2 > 1024:
         # Bit-level agreement with the XLA path is validated on hardware up

@@ -66,6 +66,64 @@ def _op_kind(rest: str) -> str:
     return "other"
 
 
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "u64": 8, "s64": 8, "u32": 4, "s32": 4, "u16": 2, "s16": 2,
+    "u8": 1, "s8": 1, "pred": 1,
+}
+_ARRAY_RE = re.compile(r"(f64|f32|bf16|f16|u64|s64|u32|s32|u16|s16|u8|s8|pred)\[([\d,]*)\]")
+
+
+def collective_payloads(txt: str) -> list[dict]:
+    """Per-hop payloads of every collective-permute in an optimized program.
+
+    Returns one record per ``collective-permute``/``collective-permute-start``
+    instruction: ``{"shape", "dtype", "bytes"}`` — the first array type ahead
+    of the op kind is the moved buffer (for async starts the output tuple's
+    leading array).  The per-hop *byte* count is what a weak-scaling budget
+    needs: payload ÷ link bandwidth + hop latency vs the measured step time.
+    """
+    out = []
+    for lines in parse_computations(txt).values():
+        for l in lines:
+            m = _INST_RE.match(l)
+            if not m:
+                continue
+            _, rest = m.groups()
+            kind = _op_kind(rest)
+            if kind not in ("collective-permute", "collective-permute-start"):
+                continue
+            head = rest.split("collective-permute")[0]
+            # Sum every non-scalar array in the (possibly tuple) type: a
+            # combined / multi-operand permute moves all of them in one hop
+            # (scalars are the async-start ops' u32 context, not payload).
+            # Async starts list each buffer TWICE — the tuple is (aliased
+            # operands..., results..., contexts...) — so halve their sum
+            # (verified against a compiled program's instruction).
+            shapes, total = [], 0
+            for dt, shp in _ARRAY_RE.findall(head):
+                if not shp:
+                    continue
+                elems = 1
+                for x in shp.split(","):
+                    elems *= int(x)
+                shapes.append(f"{dt}[{shp}]")
+                total += elems * _DTYPE_BYTES[dt]
+            if not shapes:
+                continue
+            if kind == "collective-permute-start":
+                total //= 2
+                shapes = shapes[: max(len(shapes) // 2, 1)]
+            out.append(
+                {
+                    "shape": ",".join(shapes),
+                    "dtype": shapes[0].split("[")[0],
+                    "bytes": total,
+                }
+            )
+    return out
+
+
 def collective_waits(txt: str, big_elems: int) -> tuple[int, list[bool], int]:
     """Analyze every HLO computation holding collective-permutes.
 

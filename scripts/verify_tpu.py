@@ -164,39 +164,16 @@ def _aot_hide_comm_hlo():
     import numpy as np
 
     import jax
-    from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    kind = jax.devices()[0].device_kind
-    topo = None
-    for name in (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"):
-        try:
-            topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
-            break
-        except Exception:
-            continue
-    if topo is None:
-        raise RuntimeError("no AOT topology description available")
-    devs = np.asarray(topo.devices)[:8].reshape(2, 2, 2)
-    mesh = Mesh(devs, ("x", "y", "z"))
-
-    import implicitglobalgrid_tpu as igg
     from implicitglobalgrid_tpu.models import diffusion3d
     from implicitglobalgrid_tpu.ops.overlap import hide_communication
-    from implicitglobalgrid_tpu.parallel import grid as _grid
+    from implicitglobalgrid_tpu.utils.aot import synthetic_topology_grid
 
-    # Build the per-block program against the AOT mesh via a synthetic
-    # GlobalGrid (the public init path binds to the attached client's
-    # devices, which is exactly what AOT avoids).
-    import dataclasses
-
-    igg.init_global_grid(16, 16, 16, quiet=True, devices=list(jax.devices())[:1])
-    gg0 = igg.get_global_grid()
-    gg = dataclasses.replace(
-        gg0, mesh=mesh, dims=(2, 2, 2), nprocs=8, coords=(0, 0, 0)
-    )
-    _grid.set_global_grid(gg)
-    try:
+    # Build the per-block program against the AOT mesh via the shared
+    # synthetic-GlobalGrid scaffold (the public init path binds to the
+    # attached client's devices, which is exactly what AOT avoids).
+    with synthetic_topology_grid((2, 2, 2), (16, 16, 16)) as (gg, mesh):
         params = diffusion3d.Params(
             dx=0.1, dy=0.1, dz=0.1, dt=1e-4, dtype=np.float32, hide_comm=True
         )
@@ -218,9 +195,6 @@ def _aot_hide_comm_hlo():
             (32, 32, 32), np.float32, sharding=NamedSharding(mesh, P("x", "y", "z"))
         )
         return mapped.lower(aval, aval).compile().as_text()
-    finally:
-        _grid.set_global_grid(gg0)
-        igg.finalize_global_grid()
 
 
 def check_overlap_schedule():
@@ -287,39 +261,15 @@ def _aot_staggered_fused_hlo():
     with the (8, 16) tile, so the kernel envelope accepts the block and the
     program contains BOTH the Mosaic kernel custom-call and the width-2
     slab exchanges."""
-    import dataclasses
-
     import numpy as np
 
     import jax
-    from jax.experimental import topologies
-    from jax.sharding import Mesh
 
-    kind = jax.devices()[0].device_kind
-    topo = None
-    for name in (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"):
-        try:
-            topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
-            break
-        except Exception:
-            continue
-    if topo is None:
-        raise RuntimeError("no AOT topology description available")
-    devs = np.asarray(topo.devices)[:8].reshape(2, 2, 2)
-    mesh = Mesh(devs, ("x", "y", "z"))
+    from implicitglobalgrid_tpu.utils.aot import synthetic_topology_grid
 
-    import implicitglobalgrid_tpu as igg
-    from implicitglobalgrid_tpu.models import acoustic3d
-    from implicitglobalgrid_tpu.parallel import grid as _grid
-
-    igg.init_global_grid(
-        16, 32, 128, overlapx=4, overlapy=4, overlapz=4, quiet=True,
-        devices=list(jax.devices())[:1],
-    )
-    gg0 = igg.get_global_grid()
-    gg = dataclasses.replace(gg0, mesh=mesh, dims=(2, 2, 2), nprocs=8, coords=(0, 0, 0))
-    _grid.set_global_grid(gg)
-    try:
+    with synthetic_topology_grid(
+        (2, 2, 2), (16, 32, 128), (4, 4, 4)
+    ) as (gg, mesh):
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -362,9 +312,6 @@ def _aot_staggered_fused_hlo():
             for s in ((32, 64, 256), (34, 64, 256), (32, 66, 256), (32, 64, 258))
         )
         return mapped.lower(*avals).compile().as_text()
-    finally:
-        _grid.set_global_grid(gg0)
-        igg.finalize_global_grid()
 
 
 def check_multichip_fused_aot():
@@ -398,47 +345,14 @@ def _aot_zpatch_fused_hlo(dims=(2, 2, 2), k=2, groups=1):
     slab exchanges of BOTH the field and the packed export, and the packed
     z communication of `z_patch_from_export`.  ``dims=(4,2,2)`` with
     ``groups=2`` is the 16-chip production-shape variant (check 11)."""
-    import dataclasses
-    import math
-
     import numpy as np
 
     import jax
-    from jax.experimental import topologies
-    from jax.sharding import Mesh
 
-    nchips = math.prod(dims)
-    kind = jax.devices()[0].device_kind
-    topo = None
-    names = {
-        8: (f"{kind}:2x2x2", f"{kind}:2x4", "v5e:2x4", "v5litepod-8"),
-        16: (f"{kind}:4x4", "v5e:4x4", "v5litepod-16"),
-    }[nchips]
-    for name in names:
-        try:
-            topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
-            break
-        except Exception:
-            continue
-    if topo is None:
-        raise RuntimeError("no AOT topology description available")
-    devs = np.asarray(topo.devices)[:nchips].reshape(dims)
-    mesh = Mesh(devs, ("x", "y", "z"))
+    from implicitglobalgrid_tpu.utils.aot import synthetic_topology_grid
+
     o = 2 * k
-
-    import implicitglobalgrid_tpu as igg
-    from implicitglobalgrid_tpu.parallel import grid as _grid
-
-    igg.init_global_grid(
-        16, 32, 128, overlapx=o, overlapy=o, overlapz=o, quiet=True,
-        devices=list(jax.devices())[:1],
-    )
-    gg0 = igg.get_global_grid()
-    gg = dataclasses.replace(
-        gg0, mesh=mesh, dims=tuple(dims), nprocs=nchips, coords=(0, 0, 0)
-    )
-    _grid.set_global_grid(gg)
-    try:
+    with synthetic_topology_grid(dims, (16, 32, 128), (o, o, o)) as (gg, mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from implicitglobalgrid_tpu.ops.halo import (
@@ -478,9 +392,6 @@ def _aot_zpatch_fused_hlo(dims=(2, 2, 2), k=2, groups=1):
             for _ in range(2)
         )
         return mapped.lower(*avals).compile().as_text()
-    finally:
-        _grid.set_global_grid(gg0)
-        igg.finalize_global_grid()
 
 
 def check_zpatch_export_aot():

@@ -84,6 +84,8 @@ def _fused_interpret(state, params, k, **kw):
         (2, (16, 32, 128), dict(bx=8, by=16)),
         (4, (16, 32, 128), dict(bx=8, by=16)),
         (6, (32, 32, 128), dict(bx=8, by=16)),
+        # k=8: in the envelope since round 5 (H=16 y-halo margin)
+        (8, (32, 64, 128), dict(bx=8, by=16)),
     ],
 )
 def test_fused_matches_k_single_steps(k, shape, tile):

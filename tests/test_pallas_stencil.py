@@ -48,6 +48,8 @@ def _fused_interpret(T, Cp, k, c, **kw):
         (6, (32, 32, 128), dict(bx=8, by=16)),
         # minor dim spanning >1 lane tile (validated on hardware to n2=1024)
         (2, (16, 32, 384), dict(bx=8, by=16)),
+        # k=8: in the envelope since round 5 (H=16 y-halo margin)
+        (8, (32, 64, 128), dict(bx=8, by=16)),
     ],
 )
 def test_fused_matches_k_single_steps(k, shape, tile):
@@ -249,7 +251,8 @@ def test_validation_errors():
     with pytest.raises(ValueError, match="k must be even"):
         fused_diffusion_steps(T, Cp, 3, c, c, c)
     with pytest.raises(ValueError, match="k must be even"):
-        fused_diffusion_steps(T, Cp, 8, c, c, c)
+        # k=8 is IN the envelope since round 5 (H=16 margin); 10 is out.
+        fused_diffusion_steps(T, Cp, 10, c, c, c)
     with pytest.raises(ValueError, match="does not divide"):
         fused_diffusion_steps(T, Cp, 2, c, c, c, bx=7, by=16)
     with pytest.raises(ValueError, match="minor dimension"):
