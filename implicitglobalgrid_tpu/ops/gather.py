@@ -76,16 +76,21 @@ def _block_fetch_fn(gg, ndim: int, block_shape, dtype):
         # Bitcast to unsigned integers around the transport: gather is a
         # byte-copy in the reference (MPI) and must stay byte-exact here,
         # but a float psum maps -0.0 + 0.0 to +0.0.  Integer addition with
-        # zeros preserves every bit pattern.  Multi-word dtypes (complex)
-        # bitcast to a trailing word axis and back.
+        # zeros preserves every bit pattern.  Complex cannot bitcast
+        # directly: split into a trailing real/imag float axis first (each
+        # component then round-trips bit-exactly).
+        cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
+        if cplx:
+            a = jnp.stack((a.real, a.imag), axis=-1)
         bits = lax.bitcast_convert_type(a, _word_dtype(a.dtype))
         contrib = jnp.where(my == sel, bits, jnp.zeros_like(bits))
         # psum over the field's own axes only: fields of lower rank than the
         # mesh are replicated over the remaining axes, and summing those
         # would multiply the block by the replica count.
-        return lax.bitcast_convert_type(
-            lax.psum(contrib, axes), jnp.dtype(dtype)
-        )
+        out = lax.bitcast_convert_type(lax.psum(contrib, axes), a.dtype)
+        if cplx:
+            out = lax.complex(out[..., 0], out[..., 1]).astype(jnp.dtype(dtype))
+        return out
 
     mapped = jax.shard_map(
         local,
@@ -100,11 +105,12 @@ def _block_fetch_fn(gg, ndim: int, block_shape, dtype):
 
 
 def _word_dtype(dtype):
-    """Unsigned integer word type for a byte-exact bitcast of ``dtype``
-    (multi-word dtypes like complex bitcast to a trailing word axis)."""
+    """Same-width unsigned integer type for a byte-exact bitcast of
+    ``dtype`` (complex never reaches here — `_block_fetch_fn` pre-splits it
+    into real/imag float components)."""
     import jax.numpy as jnp
 
-    return jnp.dtype(f"uint{8 * min(jnp.dtype(dtype).itemsize, 8)}")
+    return jnp.dtype(f"uint{8 * jnp.dtype(dtype).itemsize}")
 
 
 def _gather_chunked(A, gg, out: np.ndarray | None):

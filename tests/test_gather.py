@@ -105,6 +105,41 @@ def test_gather_chunked_path_matches_local(use_out):
     np.testing.assert_array_equal(got, expect)
 
 
+def test_gather_chunked_2d_field_on_3d_grid():
+    """A 2-D field on a 3-D grid is replicated over z: the masked-psum fetch
+    must psum over the field's OWN axes only ('x','y') — summing z too would
+    multiply every block by dims[2]."""
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    gg = igg.get_global_grid()
+    if gg.dims[2] < 2:
+        pytest.skip("needs a z-split mesh")
+    A = igg.from_block_fn(
+        lambda c: jnp.full((4, 4), 1.0, jnp.float64) * (1 + c[0] + 10 * c[1]),
+        (4, 4),
+        jnp.float64,
+    )
+    got = igg.gather(A, _force_chunked=True)
+    np.testing.assert_array_equal(got, igg.gather(A))
+
+
+def test_gather_chunked_complex_bitcast_roundtrip():
+    """complex64 rides the chunked transport split into real/imag float32
+    components (each bitcast to uint32 — `lax.bitcast_convert_type` cannot
+    lower complex directly); the values, incl. signed zeros in BOTH
+    components, must round-trip bit-exactly."""
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    # NB: the Python literal ``-0.0 - 0.0j`` has a +0.0 imaginary part
+    # ((-0.0) - complex(0,0) gives imag 0.0-0.0 = +0.0); construct explicitly.
+    A = igg.full((4, 4, 4), complex(-0.0, -0.0), "complex64")
+    g = igg.gather(A, _force_chunked=True)
+    assert g.dtype == np.complex64
+    assert np.signbit(g.real).all() and np.signbit(g.imag).all()
+    B = igg.full((4, 4, 4), 1.5 + 2.5j, "complex64")
+    np.testing.assert_array_equal(
+        igg.gather(B, _force_chunked=True), igg.gather(B)
+    )
+
+
 def test_gather_chunked_bit_exact_negative_zero():
     """gather is a byte-copy in the reference (MPI); the chunked transport
     bitcasts to integers around the psum so -0.0 survives (a float psum
