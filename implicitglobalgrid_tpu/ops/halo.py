@@ -558,6 +558,25 @@ def z_patch_from_export(export, *, width: int):
     return jnp.pad(packed, ((0, 0), (0, 0), (0, 128 - 2 * w)))
 
 
+#: Lane offset of the z-face band in the merged cell+z-face patch/export —
+#: THE owner of the value (the kernels import it from here).  The cell
+#: field (C/P/Pf) and its z-staggered face field (Az/Vz/qDz) share x/y
+#: extents AND x/y slab indices (they stagger only in z), so one packed
+#: array serves both at lane bands [0, 4w) and [Z_CZ_BAND, Z_CZ_BAND+4w):
+#: one kernel window fetch and one export write instead of two (round 5).
+Z_CZ_BAND = 64
+
+
+def _pack_cz(cell_band, z_band):
+    """Merge the cell and z-face 128-lane packed arrays into one: the cell
+    lanes stay at [0, ...), the z-face lanes move to [Z_CZ_BAND, ...)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [cell_band[:, :, :Z_CZ_BAND], z_band[:, :, : 128 - Z_CZ_BAND]], axis=2
+    )
+
+
 def fix_topface_z_exports(exports, C, Axp, Ayp, Azp, *, width: int):
     """Fill the frozen top-face slabs of the staggered kernels' z exports.
 
@@ -576,7 +595,7 @@ def fix_topface_z_exports(exports, C, Axp, Ayp, Azp, *, width: int):
     n0, n1, n2 = C.shape
     w = width
     o = ol(2, shape=(n0, n1, n2), gg=gg)
-    exp_c, exp_x, exp_y, exp_z = exports
+    exp_cz, exp_x, exp_y = exports
 
     def packed_lanes(row):
         return jnp.concatenate(
@@ -595,44 +614,62 @@ def fix_topface_z_exports(exports, C, Axp, Ayp, Azp, *, width: int):
     exp_y = lax.dynamic_update_slice(
         exp_y, packed_lanes(Ayp[:, n1 : n1 + 1]), (0, n1, 0)
     )
-    return exp_c, exp_x, exp_y, exp_z
+    return exp_cz, exp_x, exp_y
 
 
 def z_patches_from_exports(exports, C_shape, *, width: int):
-    """x/y-exchange the four packed z exports (real-shape slab indices via
+    """x/y-exchange the three packed z exports (real-shape slab indices via
     ``logical``) and turn each into the next group's patch — the multi-field
     z communication of the staggered z-slab cadence, all on packed arrays.
+
+    The merged cell+z-face export's x/y slab indices are the CELL's (the
+    z-face field staggers only in z); its z communication runs per lane
+    band in the non-self case, and the self-partner fast path hands the
+    whole merged array back untouched.
     """
     n0, n1, _ = C_shape
-    logicals = (None, (n0 + 1, n1, 128), (n0, n1 + 1, 128), None)
-    out = []
-    for e, lg in zip(exports, logicals):
-        e = exchange_dims(e, (0, 1), width=width, logical=lg)
-        out.append(z_patch_from_export(e, width=width))
+    exp_cz, exp_x, exp_y = exports
+    w = width
+    gg = _grid.global_grid()
+
+    exp_cz = exchange_dims(exp_cz, (0, 1), width=w)
+    if _partner_self(gg, 2):
+        patch_cz = exp_cz  # bands [L, L+2w) are already the patches
+    else:
+        cell = z_patch_from_export(exp_cz[:, :, :Z_CZ_BAND], width=w)
+        zf = z_patch_from_export(
+            exp_cz[:, :, Z_CZ_BAND : Z_CZ_BAND + 4 * w], width=w
+        )
+        patch_cz = _pack_cz(cell, zf)
+    out = [patch_cz]
+    for e, lg in ((exp_x, (n0 + 1, n1, 128)), (exp_y, (n0, n1 + 1, 128))):
+        e = exchange_dims(e, (0, 1), width=w, logical=lg)
+        out.append(z_patch_from_export(e, width=w))
     return tuple(out)
 
 
 def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1):
     """The z-dimension exchange of the four fields, as packed patch arrays.
 
-    Returns ``(patch_C, patch_Ax, patch_Ay, patch_Az)`` (`_pack_z_patch`
-    layout, extents matching each PADDED array's x/y extents so kernel tile
-    windows slice them with the same aligned offsets), or ``None`` when the
-    z dimension exchanges nothing.  Must be called AFTER the x/y exchanges
-    (sequential-dimension corner semantics).  The patches are consumed by
-    the fused kernels, which apply them to their VMEM tiles where minor-dim
-    plane surgery is free — instead of the whole-array relayouts a
-    z-`dynamic-update-slice` costs at a kernel boundary.
+    Returns ``(patch_CAz, patch_Ax, patch_Ay)`` (`_pack_z_patch` layout;
+    the cell and z-face fields share the first array's lane bands, see
+    `Z_CZ_BAND`; extents match each PADDED array's x/y extents so kernel
+    tile windows slice them with the same aligned offsets), or ``None``
+    when the z dimension exchanges nothing.  Must be called AFTER the x/y
+    exchanges (sequential-dimension corner semantics).  The patches are
+    consumed by the fused kernels, which apply them to their VMEM tiles
+    where minor-dim plane surgery is free — instead of the whole-array
+    relayouts a z-`dynamic-update-slice` costs at a kernel boundary.
     """
     gg = _grid.global_grid()
     logicals = _padded_logicals(C, Axp, Ayp, Azp)
-    out = []
+    packed = []
     for A, logical in zip((C, Axp, Ayp, Azp), logicals):
         vals = _slab_recv_values(A, 2, gg, width, logical)
         if vals is None:
             return None  # all-or-nothing: z activity is per-grid, not per-field
-        out.append(_pack_z_patch(*vals, width))
-    return tuple(out)
+        packed.append(_pack_z_patch(*vals, width))
+    return (_pack_cz(packed[0], packed[3]), packed[1], packed[2])
 
 
 def identity_z_patches(C, Axp, Ayp, Azp, *, width: int = 1):
@@ -642,13 +679,13 @@ def identity_z_patches(C, Axp, Ayp, Azp, *, width: int = 1):
     invariant), so the first fused group's patches are the planes already
     in place."""
     logicals = _padded_logicals(C, Axp, Ayp, Azp)
-    out = []
+    packed = []
     for A, logical in zip((C, Axp, Ayp, Azp), logicals):
         n = (logical or tuple(A.shape))[2]
         lo = _get_plane(A, 0, 2, width)
         hi = _get_plane(A, n - width, 2, width)
-        out.append(_pack_z_patch(lo, hi, width))
-    return tuple(out)
+        packed.append(_pack_z_patch(lo, hi, width))
+    return (_pack_cz(packed[0], packed[3]), packed[1], packed[2])
 
 
 def apply_z_patches(C, Axp, Ayp, Azp, patches, *, width: int = 1):
@@ -657,12 +694,20 @@ def apply_z_patches(C, Axp, Ayp, Azp, patches, *, width: int = 1):
     One whole-array `dynamic-update-slice` pass per field — paid once per
     CHUNK (the in-kernel application covers every group in between), so the
     relayout cost amortizes over ``nsteps``."""
+    w = width
+    patch_cz, patch_x, patch_y = patches
+    per_field = (
+        patch_cz,
+        patch_x,
+        patch_y,
+        patch_cz[:, :, Z_CZ_BAND : Z_CZ_BAND + 2 * w],
+    )
     logicals = _padded_logicals(C, Axp, Ayp, Azp)
     out = []
-    for A, logical, patch in zip((C, Axp, Ayp, Azp), logicals, patches):
+    for A, logical, patch in zip((C, Axp, Ayp, Azp), logicals, per_field):
         n = (logical or tuple(A.shape))[2]
-        A = _set_plane(A, patch[:, :, :width], 0, 2)
-        A = _set_plane(A, patch[:, :, width : 2 * width], n - width, 2)
+        A = _set_plane(A, patch[:, :, :w], 0, 2)
+        A = _set_plane(A, patch[:, :, w : 2 * w], n - w, 2)
         out.append(A)
     return tuple(out)
 

@@ -68,6 +68,7 @@ from __future__ import annotations
 import functools
 
 from . import _fused_envelope as _envelope
+from .halo import Z_CZ_BAND
 
 #: Tile candidates for auto-selection, fastest first (shared heuristics with
 #: the diffusion kernel; the 4-field working set is ~2.4x larger, so the
@@ -102,8 +103,10 @@ def _tile_bytes(n1, n2, k, bx, by, itemsize, zsets: int = 0):
         + SX * SY * (n2 + 128)  # Vz (minor pad is a full lane tile)
     )
     total = 3 * per_set
+    # Three z-window arrays per set since round 5: the cell and z-face
+    # fields share one merged array (lane bands — see `Z_CZ_BAND`).
     total += zsets * 2 * 128 * (
-        SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
+        SX * SY + (SX + 8) * SY + SX * (SY + 8)
     )
     return total * itemsize
 
@@ -114,12 +117,12 @@ _tile_error = _envelope.make_tile_error(
 _tile_error_zpatch = _envelope.make_tile_error(
     lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 1),
     _VMEM_BUDGET_BYTES,
-    "12 haloed staggered tiles spanning z + 8 z-patch windows",
+    "12 haloed staggered tiles spanning z + 6 z-patch windows",
 )
 _tile_error_zexport = _envelope.make_tile_error(
     lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 2),
     _VMEM_BUDGET_BYTES,
-    "12 haloed staggered tiles spanning z + z-patch windows + export staging",
+    "12 haloed staggered tiles spanning z + 6 z windows + 6 export stagings",
 )
 
 
@@ -213,13 +216,14 @@ def unpad_faces(Vxp, Vyp, Vzp):
 
 
 def z_patch_shapes(cell_shape):
-    """The four packed z-patch array shapes (`ops.halo.z_slab_patches`)."""
+    """The three packed z-patch array shapes (`ops.halo.z_slab_patches`):
+    merged cell+z-face (bands at lanes 0 / `ops.halo.Z_CZ_BAND`), x-face,
+    y-face."""
     n0, n1, n2 = cell_shape
     return (
         (n0, n1, 128),
         (n0 + PADS[0], n1, 128),
         (n0, n1 + PADS[1], 128),
-        (n0, n1, 128),
     )
 
 
@@ -245,8 +249,9 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
     ``[0, k)``, lanes ``[k, 2k)`` its planes ``[n_z - k, n_z)``.
 
     ``z_export`` (requires ``z_patches`` + the grid z-overlap ``z_overlap``):
-    additionally return the four packed z-slab exports (shapes
-    `z_patch_shapes`) for the NEXT group's patches — the extraction half of
+    additionally return the three packed z-slab exports (shapes
+    `z_patch_shapes`; P and Vz share the merged first array's lane bands)
+    for the NEXT group's patches — the extraction half of
     the z-anisotropy fix (see `ops.pallas_stencil.fused_diffusion_steps`).
     Lane layout per field f with logical z size ``n_f`` and overlap ``o_f``
     (``o_f = o+1`` for Vz, shape-aware): ``[0,k)`` = planes
@@ -283,8 +288,12 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
                 f"z_export needs the grid z-overlap with 2k <= o <= n2/2: "
                 f"got o={z_overlap}, k={k}, n2={n2}"
             )
-        if 4 * k > 128:
-            raise ValueError(f"z_export packs 4k lanes; k={k} > 32 unsupported")
+        if 4 * k > 128 - Z_CZ_BAND:
+            # Each merged-band half holds 4k lanes (see `ops.halo.Z_CZ_BAND`).
+            raise ValueError(
+                f"z_export packs 4k lanes per merged-band half; k={k} > "
+                f"{(128 - Z_CZ_BAND) // 4} unsupported"
+            )
     err = fused_support_error(
         (n0, n1, n2), k, P.dtype.itemsize, bx, by, zpatch=zp, zexport=z_export
     )
@@ -389,21 +398,21 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
         dp[:] = P - b * div
 
     def kernel(*refs):
-        ZXp = ZXx = ZXy = ZXz = None
+        ZXcz = ZXx = ZXy = None
         if zp and zx:
-            (Pin, Vxin, Vyin, Vzin, ZPp, ZPx, ZPy, ZPz,
-             Pout, Vxout, Vyout, Vzout, ZXp, ZXx, ZXy, ZXz) = refs
+            (Pin, Vxin, Vyin, Vzin, ZPcz, ZPx, ZPy,
+             Pout, Vxout, Vyout, Vzout, ZXcz, ZXx, ZXy) = refs
         elif zp:
-            (Pin, Vxin, Vyin, Vzin, ZPp, ZPx, ZPy, ZPz,
+            (Pin, Vxin, Vyin, Vzin, ZPcz, ZPx, ZPy,
              Pout, Vxout, Vyout, Vzout) = refs
         else:
             Pin, Vxin, Vyin, Vzin, Pout, Vxout, Vyout, Vzout = refs
-            ZPp = ZPx = ZPy = ZPz = None
+            ZPcz = ZPx = ZPy = None
 
         def body(p, vx, vy, vz, sp, svx, svy, svz,
                  p_is, vx_is, vy_is, vz_is, p_os, vx_os, vy_os, vz_os, fix_s,
-                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None,
-                 zxp=None, zxx=None, zxy=None, zxz=None, zx_os=None):
+                 zpcz=None, zpx=None, zpy=None, zp_is=None,
+                 zxcz=None, zxx=None, zxy=None, zx_os=None):
             def ixy(t):
                 return t // ncy, t % ncy
 
@@ -428,10 +437,11 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     ),
                 ) + ((
                     # z-patch windows (full-minor 128-lane fetch, the only
-                    # lane-aligned way to move a thin z slab per tile).
+                    # lane-aligned way to move a thin z slab per tile);
+                    # P and Vz ride ONE merged window (lane bands).
                     pltpu.make_async_copy(
-                        ZPp.at[pl.ds(sx, SX), pl.ds(sy, SY)],
-                        zpp.at[slot], zp_is.at[0, slot],
+                        ZPcz.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        zpcz.at[slot], zp_is.at[0, slot],
                     ),
                     pltpu.make_async_copy(
                         ZPx.at[pl.ds(sx, SX + 8), pl.ds(sy, SY)],
@@ -440,10 +450,6 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     pltpu.make_async_copy(
                         ZPy.at[pl.ds(sx, SX), pl.ds(sy, SY + 8)],
                         zpy.at[slot], zp_is.at[2, slot],
-                    ),
-                    pltpu.make_async_copy(
-                        ZPz.at[pl.ds(sx, SX), pl.ds(sy, SY)],
-                        zpz.at[slot], zp_is.at[3, slot],
                     ),
                 ) if zp else ())
 
@@ -478,8 +484,8 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                 gx, gy = ix * bx, iy * by
                 return (
                     pltpu.make_async_copy(
-                        zxp.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
-                        ZXp.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[0, slot],
+                        zxcz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXcz.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[0, slot],
                     ),
                     pltpu.make_async_copy(
                         zxx.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
@@ -488,10 +494,6 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     pltpu.make_async_copy(
                         zxy.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
                         ZXy.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[2, slot],
-                    ),
-                    pltpu.make_async_copy(
-                        zxz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
-                        ZXz.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[3, slot],
                     ),
                 )
 
@@ -554,14 +556,16 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     # whole-array relayout a z-DUS costs at the kernel
                     # boundary): lanes [0,k) -> planes [0,k), lanes [k,2k)
                     # -> the top k planes of each field's REAL z extent.
-                    p[slot, :, :, 0:k] = zpp[slot, :, :, 0:k]
-                    p[slot, :, :, SZ - k : SZ] = zpp[slot, :, :, k : 2 * k]
+                    p[slot, :, :, 0:k] = zpcz[slot, :, :, 0:k]
+                    p[slot, :, :, SZ - k : SZ] = zpcz[slot, :, :, k : 2 * k]
                     vx[slot, :, :, 0:k] = zpx[slot, :, :, 0:k]
                     vx[slot, :, :, SZ - k : SZ] = zpx[slot, :, :, k : 2 * k]
                     vy[slot, :, :, 0:k] = zpy[slot, :, :, 0:k]
                     vy[slot, :, :, SZ - k : SZ] = zpy[slot, :, :, k : 2 * k]
-                    vz[slot, :, :, 0:k] = zpz[slot, :, :, 0:k]
-                    vz[slot, :, :, SZ + 1 - k : SZ + 1] = zpz[slot, :, :, k : 2 * k]
+                    vz[slot, :, :, 0:k] = zpcz[slot, :, :, Z_CZ_BAND : Z_CZ_BAND + k]
+                    vz[slot, :, :, SZ + 1 - k : SZ + 1] = zpcz[
+                        slot, :, :, Z_CZ_BAND + k : Z_CZ_BAND + 2 * k
+                    ]
                 # k-step ping-pong between the in-slot set and the scratch
                 # set; k even, so the final state lands back in the slot.
                 for j in range(k):
@@ -581,10 +585,10 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     # z-slab export for the NEXT group's patches (VMEM
                     # extraction — see the diffusion kernel).  Vz uses its
                     # logical n_f = SZ+1, o_f = o+1 (staggered z face).
-                    zxp[slot, :, :, 0:k] = p[slot, :, :, SZ - o : SZ - o + k]
-                    zxp[slot, :, :, k : 2 * k] = p[slot, :, :, o - k : o]
-                    zxp[slot, :, :, 2 * k : 3 * k] = p[slot, :, :, 0:k]
-                    zxp[slot, :, :, 3 * k : 4 * k] = p[slot, :, :, SZ - k : SZ]
+                    zxcz[slot, :, :, 0:k] = p[slot, :, :, SZ - o : SZ - o + k]
+                    zxcz[slot, :, :, k : 2 * k] = p[slot, :, :, o - k : o]
+                    zxcz[slot, :, :, 2 * k : 3 * k] = p[slot, :, :, 0:k]
+                    zxcz[slot, :, :, 3 * k : 4 * k] = p[slot, :, :, SZ - k : SZ]
                     zxx[slot, :, :, 0:k] = vx[slot, :, :, SZ - o : SZ - o + k]
                     zxx[slot, :, :, k : 2 * k] = vx[slot, :, :, o - k : o]
                     zxx[slot, :, :, 2 * k : 3 * k] = vx[slot, :, :, 0:k]
@@ -593,10 +597,16 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                     zxy[slot, :, :, k : 2 * k] = vy[slot, :, :, o - k : o]
                     zxy[slot, :, :, 2 * k : 3 * k] = vy[slot, :, :, 0:k]
                     zxy[slot, :, :, 3 * k : 4 * k] = vy[slot, :, :, SZ - k : SZ]
-                    zxz[slot, :, :, 0:k] = vz[slot, :, :, SZ - o : SZ - o + k]
-                    zxz[slot, :, :, k : 2 * k] = vz[slot, :, :, o + 1 - k : o + 1]
-                    zxz[slot, :, :, 2 * k : 3 * k] = vz[slot, :, :, 0:k]
-                    zxz[slot, :, :, 3 * k : 4 * k] = vz[slot, :, :, SZ + 1 - k : SZ + 1]
+                    zxcz[slot, :, :, Z_CZ_BAND : Z_CZ_BAND + k] = vz[slot, :, :, SZ - o : SZ - o + k]
+                    zxcz[slot, :, :, Z_CZ_BAND + k : Z_CZ_BAND + 2 * k] = vz[
+                        slot, :, :, o + 1 - k : o + 1
+                    ]
+                    zxcz[slot, :, :, Z_CZ_BAND + 2 * k : Z_CZ_BAND + 3 * k] = vz[
+                        slot, :, :, 0:k
+                    ]
+                    zxcz[slot, :, :, Z_CZ_BAND + 3 * k : Z_CZ_BAND + 4 * k] = vz[
+                        slot, :, :, SZ + 1 - k : SZ + 1
+                    ]
                 start_out(t, slot)
                 return 0
 
@@ -629,19 +639,17 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
         )
         if zp:
             scopes.update(
-                zpp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zpcz=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zpx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
                 zpy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
-                zpz=pltpu.VMEM((2, SX, SY, 128), dt_),
-                zp_is=pltpu.SemaphoreType.DMA((4, 2)),
+                zp_is=pltpu.SemaphoreType.DMA((3, 2)),
             )
         if zx:
             scopes.update(
-                zxp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zxcz=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zxx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
                 zxy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
-                zxz=pltpu.VMEM((2, SX, SY, 128), dt_),
-                zx_os=pltpu.SemaphoreType.DMA((4, 2)),
+                zx_os=pltpu.SemaphoreType.DMA((3, 2)),
             )
         pl.run_scoped(body, **scopes)
 
@@ -659,7 +667,7 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
     call = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (8 if zp else 4),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (7 if zp else 4),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(out_shape),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)

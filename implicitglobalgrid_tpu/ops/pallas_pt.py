@@ -39,6 +39,7 @@ from __future__ import annotations
 import functools
 
 from . import _fused_envelope as _envelope
+from .halo import Z_CZ_BAND
 from .pallas_leapfrog import (  # noqa: F401  (re-export)
     pad_faces,
     padded_face_shapes,
@@ -67,8 +68,9 @@ def _tile_bytes(n1, n2, k, bx, by, itemsize, zsets: int = 0):
         + SX * SY * (n2 + 128)  # qDz
     )
     total = 3 * per_set + 2 * SX * SY * n2
+    # Three z-window arrays per set since round 5 (merged Pf+qDz bands).
     total += zsets * 2 * 128 * (
-        SX * SY + (SX + 8) * SY + SX * (SY + 8) + SX * SY
+        SX * SY + (SX + 8) * SY + SX * (SY + 8)
     )
     return total * itemsize
 
@@ -79,12 +81,12 @@ _tile_error = _envelope.make_tile_error(
 _tile_error_zpatch = _envelope.make_tile_error(
     lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 1),
     _VMEM_BUDGET_BYTES,
-    "14 haloed staggered tiles spanning z + 8 z-patch windows",
+    "14 haloed staggered tiles spanning z + 6 z-patch windows",
 )
 _tile_error_zexport = _envelope.make_tile_error(
     lambda n1, n2, k, bx, by, itemsize: _tile_bytes(n1, n2, k, bx, by, itemsize, 2),
     _VMEM_BUDGET_BYTES,
-    "14 haloed staggered tiles spanning z + z-patch windows + export staging",
+    "14 haloed staggered tiles spanning z + 6 z windows + 6 export stagings",
 )
 
 
@@ -145,9 +147,10 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
     (`ops.halo.z_slab_patches`, width ``k``), applied per tile in VMEM —
     see `ops.pallas_leapfrog.fused_leapfrog_steps`.
 
-    ``z_export``/``z_overlap``: additionally return the four packed z-slab
-    exports for the NEXT group's patches — same lane layout, top-face
-    fix-up obligation, and rationale as the leapfrog kernel's ``z_export``
+    ``z_export``/``z_overlap``: additionally return the three packed z-slab
+    exports for the NEXT group's patches (Pf and qDz share the merged
+    first array's lane bands) — same layout, top-face fix-up obligation,
+    and rationale as the leapfrog kernel's ``z_export``
     (`ops.pallas_leapfrog.fused_leapfrog_steps`).
 
     ``z_patch_width``/``z_export_width`` (default ``k``): widths of the
@@ -179,8 +182,9 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
             raise ValueError("z_patches must share the fields' dtype")
     wp = k if z_patch_width is None else int(z_patch_width)
     we = k if z_export_width is None else int(z_export_width)
-    if zp and not (k <= wp <= 64):
-        raise ValueError(f"z_patch_width must satisfy k <= wp <= 64: got {wp}, k={k}")
+    if zp and not (k <= wp <= 32):
+        # 2*wp lanes per merged-band half (see Z_CZ_BAND).
+        raise ValueError(f"z_patch_width must satisfy k <= wp <= 32: got {wp}, k={k}")
     if z_export:
         if not zp:
             raise ValueError("z_export requires z_patches (the z-slab cadence)")
@@ -189,9 +193,10 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
                 f"z_export needs the grid z-overlap with we+k <= o <= n2/2: "
                 f"got o={z_overlap}, k={k}, we={we}, n2={n2}"
             )
-        if 4 * we > 128:
+        if 4 * we > 64:
             raise ValueError(
-                f"z_export packs 4*we lanes; z_export_width={we} > 32 unsupported"
+                f"z_export packs 4*we lanes per merged-band half; "
+                f"z_export_width={we} > 16 unsupported"
             )
     err = fused_support_error(
         (n0, n1, n2), k, Pf.dtype.itemsize, bx, by, zpatch=zp, zexport=z_export
@@ -297,22 +302,22 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
         dp[:] = P - bp * div
 
     def kernel(*refs):
-        ZXp = ZXx = ZXy = ZXz = None
+        ZXcz = ZXx = ZXy = None
         if zp and zx:
-            (Tin, Pfin, Qxin, Qyin, Qzin, ZPp, ZPx, ZPy, ZPz,
-             Pfout, Qxout, Qyout, Qzout, ZXp, ZXx, ZXy, ZXz) = refs
+            (Tin, Pfin, Qxin, Qyin, Qzin, ZPcz, ZPx, ZPy,
+             Pfout, Qxout, Qyout, Qzout, ZXcz, ZXx, ZXy) = refs
         elif zp:
-            (Tin, Pfin, Qxin, Qyin, Qzin, ZPp, ZPx, ZPy, ZPz,
+            (Tin, Pfin, Qxin, Qyin, Qzin, ZPcz, ZPx, ZPy,
              Pfout, Qxout, Qyout, Qzout) = refs
         else:
             Tin, Pfin, Qxin, Qyin, Qzin, Pfout, Qxout, Qyout, Qzout = refs
-            ZPp = ZPx = ZPy = ZPz = None
+            ZPcz = ZPx = ZPy = None
 
         def body(t, p, qx, qy, qz, sp, sqx, sqy, sqz,
                  t_is, p_is, qx_is, qy_is, qz_is,
                  p_os, qx_os, qy_os, qz_os, fix_s,
-                 zpp=None, zpx=None, zpy=None, zpz=None, zp_is=None,
-                 zxp=None, zxx=None, zxy=None, zxz=None, zx_os=None):
+                 zpcz=None, zpx=None, zpy=None, zp_is=None,
+                 zxcz=None, zxx=None, zxy=None, zx_os=None):
             def ixy(tt):
                 return tt // ncy, tt % ncy
 
@@ -339,9 +344,10 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                         qz.at[slot], qz_is.at[slot],
                     ),
                 ) + ((
+                    # Pf and qDz ride ONE merged window (lane bands).
                     pltpu.make_async_copy(
-                        ZPp.at[pl.ds(sx, SX), pl.ds(sy, SY)],
-                        zpp.at[slot], zp_is.at[0, slot],
+                        ZPcz.at[pl.ds(sx, SX), pl.ds(sy, SY)],
+                        zpcz.at[slot], zp_is.at[0, slot],
                     ),
                     pltpu.make_async_copy(
                         ZPx.at[pl.ds(sx, SX + 8), pl.ds(sy, SY)],
@@ -350,10 +356,6 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                     pltpu.make_async_copy(
                         ZPy.at[pl.ds(sx, SX), pl.ds(sy, SY + 8)],
                         zpy.at[slot], zp_is.at[2, slot],
-                    ),
-                    pltpu.make_async_copy(
-                        ZPz.at[pl.ds(sx, SX), pl.ds(sy, SY)],
-                        zpz.at[slot], zp_is.at[3, slot],
                     ),
                 ) if zp else ())
 
@@ -388,8 +390,8 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                 gx, gy = ix * bx, iy * by
                 return (
                     pltpu.make_async_copy(
-                        zxp.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
-                        ZXp.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[0, slot],
+                        zxcz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
+                        ZXcz.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[0, slot],
                     ),
                     pltpu.make_async_copy(
                         zxx.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
@@ -398,10 +400,6 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                     pltpu.make_async_copy(
                         zxy.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
                         ZXy.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[2, slot],
-                    ),
-                    pltpu.make_async_copy(
-                        zxz.at[slot, pl.ds(ox, bx), pl.ds(oy, by)],
-                        ZXz.at[pl.ds(gx, bx), pl.ds(gy, by)], zx_os.at[3, slot],
                     ),
                 )
 
@@ -458,14 +456,16 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                     # Apply the z-exchange patches in VMEM (see the
                     # leapfrog kernel): lanes [0,wp) -> planes [0,wp),
                     # lanes [wp,2wp) -> the top wp planes of each field.
-                    p[slot, :, :, 0:wp] = zpp[slot, :, :, 0:wp]
-                    p[slot, :, :, SZ - wp : SZ] = zpp[slot, :, :, wp : 2 * wp]
+                    p[slot, :, :, 0:wp] = zpcz[slot, :, :, 0:wp]
+                    p[slot, :, :, SZ - wp : SZ] = zpcz[slot, :, :, wp : 2 * wp]
                     qx[slot, :, :, 0:wp] = zpx[slot, :, :, 0:wp]
                     qx[slot, :, :, SZ - wp : SZ] = zpx[slot, :, :, wp : 2 * wp]
                     qy[slot, :, :, 0:wp] = zpy[slot, :, :, 0:wp]
                     qy[slot, :, :, SZ - wp : SZ] = zpy[slot, :, :, wp : 2 * wp]
-                    qz[slot, :, :, 0:wp] = zpz[slot, :, :, 0:wp]
-                    qz[slot, :, :, SZ + 1 - wp : SZ + 1] = zpz[slot, :, :, wp : 2 * wp]
+                    qz[slot, :, :, 0:wp] = zpcz[slot, :, :, Z_CZ_BAND : Z_CZ_BAND + wp]
+                    qz[slot, :, :, SZ + 1 - wp : SZ + 1] = zpcz[
+                        slot, :, :, Z_CZ_BAND + wp : Z_CZ_BAND + 2 * wp
+                    ]
                 tv = t[slot]
                 for j in range(k):
                     if j % 2 == 0:
@@ -484,10 +484,10 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                     # z-slab export for the NEXT group's patches (VMEM
                     # extraction — see the leapfrog kernel).  Qz uses its
                     # logical n_f = SZ+1, o_f = o+1 (staggered z face).
-                    zxp[slot, :, :, 0:we] = p[slot, :, :, SZ - o : SZ - o + we]
-                    zxp[slot, :, :, we : 2 * we] = p[slot, :, :, o - we : o]
-                    zxp[slot, :, :, 2 * we : 3 * we] = p[slot, :, :, 0:we]
-                    zxp[slot, :, :, 3 * we : 4 * we] = p[slot, :, :, SZ - we : SZ]
+                    zxcz[slot, :, :, 0:we] = p[slot, :, :, SZ - o : SZ - o + we]
+                    zxcz[slot, :, :, we : 2 * we] = p[slot, :, :, o - we : o]
+                    zxcz[slot, :, :, 2 * we : 3 * we] = p[slot, :, :, 0:we]
+                    zxcz[slot, :, :, 3 * we : 4 * we] = p[slot, :, :, SZ - we : SZ]
                     zxx[slot, :, :, 0:we] = qx[slot, :, :, SZ - o : SZ - o + we]
                     zxx[slot, :, :, we : 2 * we] = qx[slot, :, :, o - we : o]
                     zxx[slot, :, :, 2 * we : 3 * we] = qx[slot, :, :, 0:we]
@@ -496,10 +496,16 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                     zxy[slot, :, :, we : 2 * we] = qy[slot, :, :, o - we : o]
                     zxy[slot, :, :, 2 * we : 3 * we] = qy[slot, :, :, 0:we]
                     zxy[slot, :, :, 3 * we : 4 * we] = qy[slot, :, :, SZ - we : SZ]
-                    zxz[slot, :, :, 0:we] = qz[slot, :, :, SZ - o : SZ - o + we]
-                    zxz[slot, :, :, we : 2 * we] = qz[slot, :, :, o + 1 - we : o + 1]
-                    zxz[slot, :, :, 2 * we : 3 * we] = qz[slot, :, :, 0:we]
-                    zxz[slot, :, :, 3 * we : 4 * we] = qz[slot, :, :, SZ + 1 - we : SZ + 1]
+                    zxcz[slot, :, :, Z_CZ_BAND : Z_CZ_BAND + we] = qz[slot, :, :, SZ - o : SZ - o + we]
+                    zxcz[slot, :, :, Z_CZ_BAND + we : Z_CZ_BAND + 2 * we] = qz[
+                        slot, :, :, o + 1 - we : o + 1
+                    ]
+                    zxcz[slot, :, :, Z_CZ_BAND + 2 * we : Z_CZ_BAND + 3 * we] = qz[
+                        slot, :, :, 0:we
+                    ]
+                    zxcz[slot, :, :, Z_CZ_BAND + 3 * we : Z_CZ_BAND + 4 * we] = qz[
+                        slot, :, :, SZ + 1 - we : SZ + 1
+                    ]
                 start_out(tt, slot)
                 return 0
 
@@ -532,19 +538,17 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
         )
         if zp:
             scopes.update(
-                zpp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zpcz=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zpx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
                 zpy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
-                zpz=pltpu.VMEM((2, SX, SY, 128), dt_),
-                zp_is=pltpu.SemaphoreType.DMA((4, 2)),
+                zp_is=pltpu.SemaphoreType.DMA((3, 2)),
             )
         if zx:
             scopes.update(
-                zxp=pltpu.VMEM((2, SX, SY, 128), dt_),
+                zxcz=pltpu.VMEM((2, SX, SY, 128), dt_),
                 zxx=pltpu.VMEM((2, SX + 8, SY, 128), dt_),
                 zxy=pltpu.VMEM((2, SX, SY + 8, 128), dt_),
-                zxz=pltpu.VMEM((2, SX, SY, 128), dt_),
-                zx_os=pltpu.SemaphoreType.DMA((4, 2)),
+                zx_os=pltpu.SemaphoreType.DMA((3, 2)),
             )
         pl.run_scoped(body, **scopes)
 
@@ -562,7 +566,7 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
     call = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (9 if zp else 5),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (8 if zp else 5),
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(out_shape),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)
