@@ -126,3 +126,25 @@ def test_fused_provenance_labels():
     assert _fused_provenance(2, pt_err, (160, 160, 160), 4, None) == (
         "_fused2fb", "xla-fallback"
     )
+
+
+def test_collective_payloads_parser():
+    """Unit pin of the HLO payload reader behind the weak-scaling AOT proxy:
+    sync permutes count once, async starts halve their duplicated
+    operand/result tuple (verified against a real compiled instruction),
+    scalar context words are excluded, -done ops are not hops."""
+    from implicitglobalgrid_tpu.utils.hlo_analysis import collective_payloads
+
+    txt = """
+ENTRY %main (p0: f32[4,8]) -> f32[4,8] {
+  %a = f32[4,8]{1,0} collective-permute(%p0), source_target_pairs={{0,1}}
+  %b = (f32[2,8]{1,0:T(8,128)S(1)}, f32[2,8]{1,0:T(8,128)S(1)}, u32[]{:S(2)}, u32[]{:S(2)}) collective-permute-start(%s), source_target_pairs={{0,1}}
+  %c = f32[2,8]{1,0} collective-permute-done(%b)
+  %d = (f32[4,8]{1,0}, f32[2,2]{1,0}) collective-permute(%x, %y), source_target_pairs={{1,0}}
+}
+"""
+    hops = collective_payloads(txt)
+    assert len(hops) == 3  # a, b, d — NOT the -done
+    by_bytes = sorted(h["bytes"] for h in hops)
+    # a: 4*8*4 = 128; b: (2*8*4)*2/2 = 64; d: 4*8*4 + 2*2*4 = 144
+    assert by_bytes == [64, 128, 144]
