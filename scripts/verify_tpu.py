@@ -482,6 +482,82 @@ def check_pt_fused():
     print(f"8. fused PT-iteration kernel vs XLA (compiled): OK, worst rel={worst:.2e}")
 
 
+
+
+def check_transposed_zpatch_aot():
+    """Round 5: AOT-pin the TRANSPOSED z-patch cadence's hop structure on a
+    2x2x2 topology — the full-y tile (by == n1) routes the diffusion cadence
+    through the transposed thin-patch machinery (`ops.halo.*_t`), and the
+    compiled program's collective-permutes must all move slab-sized
+    payloads (never a full block).  The transposed routing itself is pinned
+    structurally: the export's y exchange slices axis 2 (an
+    `exchange_dims_t`-only shape), so its (n0, PE, w) hop can only exist if
+    the cadence really built transposed patches."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from implicitglobalgrid_tpu.utils.aot import synthetic_topology_grid
+    from implicitglobalgrid_tpu.utils.hlo_analysis import collective_payloads
+
+    k = 2
+    try:
+        ctx = synthetic_topology_grid((2, 2, 2), (16, 32, 128), (4, 4, 4))
+        ctx.__enter__()
+    except Exception as e:  # noqa: BLE001 — AOT topology is the only skip
+        print(
+            f"12. transposed z-patch cadence AOT: SKIPPED ({type(e).__name__}: "
+            f"{e}) — the layout equivalence is pinned by tests/test_update_halo"
+            ".py::test_transposed_z_patch_communication_matches_packed on the "
+            "CPU mesh"
+        )
+        return
+    try:
+        gg = None
+        from implicitglobalgrid_tpu.parallel.grid import global_grid
+
+        gg = global_grid()
+        mesh = gg.mesh
+        from implicitglobalgrid_tpu.models import diffusion3d
+
+        params = diffusion3d.Params(
+            dx=0.1, dy=0.1, dz=0.1, dt=1e-4, dtype=jax.numpy.float32
+        )
+        step = diffusion3d.make_multi_step(
+            params, 2 * k, donate=False, fused_k=k, fused_tile=(8, 32)
+        )
+        shapes = tuple(
+            jax.ShapeDtypeStruct(
+                (32, 64, 256), jax.numpy.float32,
+                sharding=NamedSharding(mesh, P("x", "y", "z")),
+            )
+            for _ in range(2)
+        )
+        fn = step._build(gg, shapes, jax.tree.flatten(shapes)[1])
+        txt = fn.lower(*shapes).compile().as_text()
+    finally:
+        ctx.__exit__(None, None, None)
+    assert "tpu_custom_call" in txt, "no Mosaic kernel custom-call in the AOT program"
+    hops = collective_payloads(txt)
+    assert len(hops) >= 10, f"expected >= 10 hops, got {len(hops)}"
+    block_bytes = 16 * 32 * 128 * 4
+    biggest = max(h["bytes"] for h in hops)
+    assert biggest < block_bytes // 4, (
+        f"a collective moves {biggest} bytes — slab exchanges should be far "
+        f"below the {block_bytes}-byte block (full-array z exchange regression?)"
+    )
+    # The transposed-routing signature: the export's axis-2 y-slab hop,
+    # shape (n0, pad8(4k), w) = (16, 8, 2).
+    assert any(h["shape"] == "f32[16,8,2]" for h in hops), (
+        "no (16,8,2) export y-slab hop — the cadence did not route through "
+        f"the transposed patch machinery (hops: {[h['shape'] for h in hops]})"
+    )
+    print(
+        f"12. transposed z-patch cadence AOT (2x2x2, full-y tile): OK — "
+        f"{len(hops)} slab hops incl. the (16,8,2) transposed-export y hop, "
+        f"largest {biggest} B << {block_bytes} B block"
+    )
+
+
 if __name__ == "__main__":
     import jax
 
@@ -497,4 +573,5 @@ if __name__ == "__main__":
     check_multichip_fused_aot()
     check_zpatch_export_aot()
     check_zpatch_export_aot_16chip()
+    check_transposed_zpatch_aot()
     print("ALL TPU CHECKS PASSED")

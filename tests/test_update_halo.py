@@ -532,3 +532,85 @@ def test_padded_faces_exchange_matches_unpadded(initkw, width):
     for name, g, r in zip(("cell", "fx", "fy", "fz"), got, ref):
         np.testing.assert_array_equal(np.asarray(g), r, err_msg=name)
     igg.finalize_global_grid()
+
+
+@pytest.mark.parametrize(
+    "dims,periods",
+    [
+        ((1, 2, 4), (0, 1, 1)),   # y + z active, periodic z (multi-hop)
+        ((2, 1, 4), (0, 0, 1)),   # x + z active
+        ((2, 2, 2), (1, 1, 0)),   # all dims active, non-periodic z (PROC_NULL)
+    ],
+)
+def test_transposed_z_patch_communication_matches_packed(dims, periods):
+    """The transposed thin-patch communication (`exchange_dims_t` with its
+    axis-2 y-slab override + `z_patch_from_export_t`) against the packed
+    128-lane path on x/y-ACTIVE grids — the interpret-mode kernel oracles
+    can only run 2-device meshes (dims product cap), so the helper-level
+    equivalence is pinned here on the full 8-device mesh, kernels excluded:
+    both paths communicate the same synthetic export content, and the
+    resulting patches must carry identical values plane-for-plane."""
+    from implicitglobalgrid_tpu.ops.halo import (
+        _pad8,
+        _pad128,
+        exchange_dims,
+        exchange_dims_t,
+        z_patch_from_export,
+        z_patch_from_export_t,
+    )
+
+    w = 2
+    n0, n1, n2 = 8, 8, 128
+    PB = _pad8(4 * w)
+    n1p = _pad128(n1)
+    igg.init_global_grid(
+        n0, n1, n2, dimx=dims[0], dimy=dims[1], dimz=dims[2],
+        periodx=periods[0], periody=periods[1], periodz=periods[2],
+        overlapx=2 * w, overlapy=2 * w, overlapz=2 * w, quiet=True,
+    )
+    gg = igg.get_global_grid()
+    assert tuple(gg.dims) == dims
+
+    def block_vals(coords):
+        cx, cy, cz = coords
+        key = jax.random.PRNGKey((cx * 7 + cy) * 11 + cz)
+        return jax.random.normal(key, (n0, n1, 4 * w))
+
+    def packed_fn(c):
+        return jnp.pad(block_vals(c), ((0, 0), (0, 0), (0, 128 - 4 * w)))
+
+    def transposed_fn(c):
+        v = block_vals(c).transpose(0, 2, 1)  # (n0, 4w, n1)
+        return jnp.pad(v, ((0, 0), (0, PB - 4 * w), (0, n1p - n1)))
+
+    packed = igg.from_block_fn(packed_fn, (n0, n1, 128))
+    transp = igg.from_block_fn(transposed_fn, (n0, PB, n1p))
+
+    @igg.stencil
+    def run_packed(e):
+        e = exchange_dims(e, (0, 1), width=w)
+        return z_patch_from_export(e, width=w)
+
+    @igg.stencil
+    def run_transposed(e):
+        e = exchange_dims_t(e, width=w, shape=(n0, n1, n2))
+        return z_patch_from_export_t(e, width=w)
+
+    p_packed = np.asarray(igg.gather(run_packed(packed)))
+    p_transp = np.asarray(igg.gather(run_transposed(transp)))
+    igg.finalize_global_grid()
+
+    # Compare plane-for-plane per block: packed lanes [0, 2w) == transposed
+    # planes [0, 2w) transposed back.
+    for cx in range(dims[0]):
+        for cy in range(dims[1]):
+            for cz in range(dims[2]):
+                a = p_packed[
+                    cx * n0:(cx + 1) * n0, cy * n1:(cy + 1) * n1,
+                    cz * 128:cz * 128 + 2 * w,
+                ]
+                b = p_transp[
+                    cx * n0:(cx + 1) * n0, cy * PB:cy * PB + 2 * w,
+                    cz * n1p:cz * n1p + n1,
+                ].transpose(0, 2, 1)
+                np.testing.assert_array_equal(a, b, err_msg=f"block {(cx, cy, cz)}")
