@@ -6,7 +6,8 @@ Thin wrapper over `benchmarks/run.py` (the full harness — weak scaling,
 acoustic, porous configs live there); this entry point measures the headline
 config on both production paths — the plain XLA stencil and the
 temporally-blocked Pallas kernel (`implicitglobalgrid_tpu/ops/pallas_stencil.py`,
-k=4 steps per HBM pass, 32x64 tiles tuned on v5e — ~1.4x the XLA path there)
+k=4 steps per HBM pass, full-y (32, n1) tiles since round 5 — ~1.7x the XLA
+path on v5e)
 — and reports the faster one, with both recorded in ``extras`` alongside the
 remaining BASELINE.json configs (comm/compute-overlap variant, acoustic,
 porous) so every promised config has a round artifact.

@@ -1,20 +1,24 @@
 """3-D heat diffusion, fused deep-halo cadence on a z-split decomposition.
 
-The round-4 production path for topologies that split the MINOR (z)
-dimension — where a naive slab exchange is the most expensive (minor-dim
-plane surgery at lane-unaligned offsets forces whole-array relayouts at the
-Pallas kernel boundary; docs/performance.md's exchanged-dimension anisotropy
-section).  `make_multi_step(fused_k=k)` detects z halo activity and routes
-the z exchange through packed 128-lane patch arrays: the kernel applies the
-incoming patch tile-by-tile in VMEM AND exports the next group's send slabs
-(`ops/pallas_stencil.py` ``z_export``), so the z communication runs entirely
-on small packed arrays (`ops/halo.py::z_patch_from_export` — on a mesh, the
-z `collective_permute` moves (nx, ny, k) slabs instead of full fields).
+The production path for topologies that split the MINOR (z) dimension —
+where a naive slab exchange is the most expensive (minor-dim plane surgery
+at lane-unaligned offsets forces whole-array relayouts at the Pallas kernel
+boundary; docs/performance.md's exchanged-dimension anisotropy section).
+`make_multi_step(fused_k=k)` detects z halo activity and routes the z
+exchange through small patch arrays: the kernel applies the incoming patch
+tile-by-tile in VMEM AND exports the next group's send slabs
+(`ops/pallas_stencil.py` ``z_export``), so the z communication runs
+entirely on thin arrays — on a mesh the z `collective_permute` moves
+(nx, ny, k)-sized slabs instead of full fields.  Since round 5 the
+diffusion cadence auto-selects full-y tiles where VMEM allows and then uses
+the TRANSPOSED thin-patch layout (`ops/halo.py::z_patch_from_export_t`,
+~16x less patch window traffic than the packed 128-lane form it falls back
+to on y-windowed tiles).
 
 Measured on one v5e chip (periodic-z self-neighbor degenerate config, the
-same exchange work a z-split mesh pays per hop): 256^3 f32 k=4 at ~409
-GB/s/chip effective vs ~210 for the round-2 non-kernel cadence; the acoustic
-analogue reaches ~845 GB/s (vs 557 receive-side-only).
+same exchange work a z-split mesh pays per hop): 256^3 f32 k=4 at ~520
+GB/s/chip effective (round 4 packed: 409; round-2 non-kernel cadence:
+~210); the acoustic analogue reaches ~855 GB/s (vs 557 receive-side-only).
 
 The reference has no counterpart: its z exchange always copies full halo
 planes through staged buffers (`/root/reference/src/update_halo.jl:544-563`).
