@@ -23,6 +23,12 @@ Public API (reference parity, `/root/reference/src/ImplicitGlobalGrid.jl:10-21`)
 plus the TPU-native field toolkit: `zeros`/`ones`/`full`/`from_block_fn`,
 `coord_fields`, `block_slice`, and the `stencil` decorator that turns
 single-block solver code into a pod-wide SPMD program.
+
+Production resilience (docs/robustness.md): guarded multi-host bring-up
+(retry/backoff/deadline + `watchdog`), NaN/Inf guards (`check_fields`,
+`RunGuard`), and per-process checkpoint/restart (`save_checkpoint` /
+`restore_checkpoint` / `latest_checkpoint`) with an `IGG_FAULT_INJECT`
+harness proving the recovery paths.
 """
 
 from .parallel.grid import (
@@ -53,6 +59,18 @@ from .utils.fields import (
     full,
     ones,
     zeros,
+)
+from .utils.resilience import (
+    FieldReport,
+    GuardError,
+    RunGuard,
+    check_fields,
+    watchdog,
+)
+from .utils.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
 )
 
 __version__ = "0.1.0"
@@ -97,4 +115,13 @@ __all__ = [
     "ol",
     "local_shape",
     "distributed",
+    # resilience subsystem (docs/robustness.md)
+    "check_fields",
+    "FieldReport",
+    "GuardError",
+    "RunGuard",
+    "watchdog",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint",
 ]

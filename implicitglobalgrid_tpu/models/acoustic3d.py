@@ -441,23 +441,45 @@ def make_multi_step(
     return stencil(block_step, donate_argnums=donate_argnums)
 
 
-def run(nt: int, nx: int = 64, ny: int = 64, nz: int = 64, *, finalize: bool = True, **kw):
-    """End-to-end run; returns the final global-block pressure field."""
+def run(
+    nt: int,
+    nx: int = 64,
+    ny: int = 64,
+    nz: int = 64,
+    *,
+    finalize: bool = True,
+    guard_every: int | None = None,
+    guard_policy: str | None = None,
+    checkpoint_every: int | None = None,
+    checkpoint_dir: str | None = None,
+    **kw,
+):
+    """End-to-end run; returns the final global-block pressure field.
+
+    Resilience hooks as in `models.diffusion3d.run` (``guard_every`` /
+    ``guard_policy`` / ``checkpoint_every`` / ``checkpoint_dir``)."""
     import jax
 
     from ..parallel.grid import global_grid
 
     from ..parallel.grid import grid_is_initialized
+    from ..utils.resilience import RunGuard, guarded_time_loop
 
     caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
     try:
         state, params = setup(nx, ny, nz, **kw)
         step = make_step(params)
+        guard = RunGuard(
+            guard_every=guard_every,
+            policy=guard_policy,
+            checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            names=("P", "Vx", "Vy", "Vz"),
+        )
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
-        for _ in range(nt):
-            state = step(*state)
-            if sync_every_step:
-                jax.block_until_ready(state)
+        state = guarded_time_loop(
+            step, state, nt, guard=guard, sync_every_step=sync_every_step
+        )
         P = jax.block_until_ready(state[0])
     except BaseException:
         # A failed run must not poison the next init_global_grid in this

@@ -455,28 +455,46 @@ def run(
     nz: int = 128,
     *,
     finalize: bool = True,
+    guard_every: int | None = None,
+    guard_policy: str | None = None,
+    checkpoint_every: int | None = None,
+    checkpoint_dir: str | None = None,
     **setup_kwargs,
 ):
     """End-to-end run (the reference's ``diffusion3D()`` without visualization).
 
     Returns the final global-block temperature field.
+
+    Resilience hooks (kwarg > ``IGG_*`` env > off; docs/robustness.md):
+    ``guard_every=N`` runs the `igg.check_fields` NaN/Inf probe every ``N``
+    steps under ``guard_policy`` (``raise`` | ``warn`` | ``rollback``);
+    ``checkpoint_every=N`` writes restartable checkpoints to
+    ``checkpoint_dir`` — a rerun pointing at the same directory resumes
+    from the latest one.
     """
     import jax
 
     from ..parallel.grid import global_grid, grid_is_initialized
+    from ..utils.resilience import RunGuard, guarded_time_loop
 
     caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
     try:
         state, params = setup(nx, ny, nz, **setup_kwargs)
         step = make_step(params)
+        guard = RunGuard(
+            guard_every=guard_every,
+            policy=guard_policy,
+            checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            names=("T", "Cp"),
+        )
         # On the virtual CPU mesh, XLA's in-process collectives deadlock if
         # too many asynchronously dispatched programs pile up; syncing each
         # step costs nothing there and is skipped on real accelerators.
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
-        for _ in range(nt):
-            state = step(*state)
-            if sync_every_step:
-                jax.block_until_ready(state)
+        state = guarded_time_loop(
+            step, state, nt, guard=guard, sync_every_step=sync_every_step
+        )
         T = jax.block_until_ready(state[0])
     except BaseException:
         # A failed run must not poison the next init_global_grid in this

@@ -92,7 +92,9 @@ def _block_fetch_fn(gg, ndim: int, block_shape, dtype):
             out = lax.complex(out[..., 0], out[..., 1]).astype(jnp.dtype(dtype))
         return out
 
-    mapped = jax.shard_map(
+    from ..utils.compat import shard_map
+
+    mapped = shard_map(
         local,
         mesh=gg.mesh,
         in_specs=(P(*axes), P()),
@@ -122,6 +124,8 @@ def _gather_chunked(A, gg, out: np.ndarray | None):
     ``out is not None``) places each block as it arrives; the replicated
     device copy is dropped before the next fetch.
     """
+    import jax
+
     global last_gather_stats
     ndim = A.ndim
     bshape = _local_shape(A, gg)
@@ -132,6 +136,16 @@ def _gather_chunked(A, gg, out: np.ndarray | None):
     for idx in np.ndindex(*dims):
         sel = np.ravel_multi_index(idx, dims) if dims else 0
         blk = fetch(A, np.int32(sel))
+        # EVERY process completes each fetch before dispatching the next —
+        # not just the root (whose host copy syncs implicitly).  Without
+        # this, non-roots enqueue all fetches asynchronously: up to
+        # dims-many identical collectives in flight, which (a) starves the
+        # single-core CPU mesh's rendezvous and (b) can cross-match on
+        # transports without per-op channels (observed as intermittent
+        # wrong fill-in-place gathers under the gloo backend — the root's
+        # assembled bytes mixed blocks).  One outstanding collective per
+        # process is also what the docstring's memory bound promises.
+        jax.block_until_ready(blk)
         if out is not None:  # the root, assembling (see `gather`)
             data = np.asarray(blk.addressable_shards[0].data)
             out[tuple(slice(c * b, (c + 1) * b) for c, b in zip(idx, bshape))] = data

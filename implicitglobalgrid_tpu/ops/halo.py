@@ -59,6 +59,23 @@ from ..parallel.topology import AXIS_NAMES, NDIMS
 
 _jit_cache: dict = {}
 
+# Guard/fault hook point: called on the OUTPUT tuple of every global-array
+# `update_halo` (the host-side boundary where concrete fields exist — traced
+# contexts inline into the caller's program and cannot run host hooks).  Two
+# users: the fault-injection harness corrupts exchanged fields here
+# (`utils.resilience.install_halo_fault_hook`), and debugging sessions can
+# install a `check_fields` probe to localize which exchange first saw a NaN.
+_post_exchange_hook = None
+
+
+def set_post_exchange_hook(fn):
+    """Install ``fn(fields_tuple) -> fields_tuple`` (or None to remove).
+    Returns the previously installed hook."""
+    global _post_exchange_hook
+    prev = _post_exchange_hook
+    _post_exchange_hook = fn
+    return prev
+
 
 def _clear_caches() -> None:
     _jit_cache.clear()
@@ -112,7 +129,7 @@ def halosize(dim: int, A, gg=None) -> tuple[int, ...]:
     return (1,)
 
 
-def check_fields(fields, gg) -> None:
+def _validate_fields(fields, gg) -> None:
     """Input validation ported from `/root/reference/src/update_halo.jl:804-834`.
 
     The reference's third check (identical concrete types) exists only because
@@ -778,8 +795,10 @@ def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True):
         _jit_cache[key] = fn
         return fn
 
+    from ..utils.compat import shard_map
+
     specs = tuple(P(*AXIS_NAMES[:nd]) for nd in ndims_per_field)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         exchange, mesh=gg.mesh, in_specs=specs, out_specs=specs, check_vma=False
     )
     fn = jax.jit(mapped, donate_argnums=dn)
@@ -818,7 +837,7 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None):
         raise ValueError("update_halo requires at least one field.")
     if width < 1:
         raise ValueError(f"width must be >= 1 (got {width})")
-    check_fields(fields, gg)
+    _validate_fields(fields, gg)
     if any(_is_tracer(A) for A in fields):
         if not all(_is_tracer(A) for A in fields):
             # A concrete global-block array mixed into a traced (local-view)
@@ -842,4 +861,6 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None):
         if donate is None:
             donate = _default_donate()
         out = _global_update_fn(gg, sig, width, bool(donate))(*arrs)
+        if _post_exchange_hook is not None:
+            out = tuple(_post_exchange_hook(tuple(out)))
     return out[0] if len(fields) == 1 else tuple(out)

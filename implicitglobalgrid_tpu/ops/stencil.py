@@ -120,7 +120,9 @@ class _Stencil:
         else:
             out_specs = self._infer_out_specs(gg, in_specs, args)
 
-        mapped = jax.shard_map(
+        from ..utils.compat import shard_map
+
+        mapped = shard_map(
             self._fn,
             mesh=gg.mesh,
             in_specs=tuple(in_specs),
@@ -150,7 +152,9 @@ class _Stencil:
         import jax
         from jax.sharding import PartitionSpec as P
 
-        probe = jax.shard_map(
+        from ..utils.compat import shard_map
+
+        probe = shard_map(
             self._fn,
             mesh=gg.mesh,
             in_specs=tuple(in_specs),
@@ -162,7 +166,7 @@ class _Stencil:
         rank_specs = [_infer_spec_from_ndim(len(l.shape)) for l in shape_leaves]
 
         def vma_mapped(specs):
-            return jax.shard_map(
+            return shard_map(
                 self._fn,
                 mesh=gg.mesh,
                 in_specs=tuple(in_specs),

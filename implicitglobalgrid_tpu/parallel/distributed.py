@@ -28,35 +28,117 @@ def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    retries: int | None = None,
+    timeout_s: float | None = None,
+    backoff_s: float | None = None,
     **kwargs,
 ) -> None:
-    """Initialize the JAX distributed runtime (multi-host).
+    """Initialize the JAX distributed runtime (multi-host), with retry.
 
     The analogue of `MPI.Init()` in `init_global_grid`
     (`/root/reference/src/init_global_grid.jl:78-83`).  On Cloud TPU pods the
     arguments are auto-detected and may all be ``None``.  Safe to call when
     already initialized (no-op), mirroring the reference's `init_MPI=false`
     escape hatch.
+
+    Bring-up is *guarded* (coordinator races are the #1 multi-host failure
+    at pod scale): a failed `jax.distributed.initialize` is retried with
+    exponential backoff + seeded jitter under an overall deadline, and a
+    watchdog dumps all-thread stacks if an attempt hangs past the deadline.
+    Knobs resolve kwarg > env > default (the reference's configuration
+    tiers): ``retries`` / ``IGG_INIT_RETRIES`` (default 3), ``timeout_s`` /
+    ``IGG_INIT_TIMEOUT_S`` (default 600), ``backoff_s`` /
+    ``IGG_INIT_BACKOFF_S`` (default 1).
     """
     import jax
+
+    from ..utils import config as _config
+    from ..utils import resilience as _resilience
 
     global _owns_runtime
     if is_distributed_initialized():
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+    if retries is None:
+        retries = _config.init_retries_env()
+        retries = _resilience.DEFAULT_INIT_RETRIES if retries is None else retries
+    if timeout_s is None:
+        timeout_s = _config.init_timeout_env()
+        timeout_s = (
+            _resilience.DEFAULT_INIT_TIMEOUT_S if timeout_s is None else timeout_s
+        )
+    if backoff_s is None:
+        backoff_s = _config.init_backoff_env()
+        backoff_s = (
+            _resilience.DEFAULT_INIT_BACKOFF_S if backoff_s is None else backoff_s
+        )
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0 (got {retries})")
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be > 0 (got {timeout_s})")
+    injector = _resilience.get_fault_injector()
+
+    def attempt():
+        injector.maybe_flake_init()  # IGG_FAULT_INJECT=init_flake:N harness
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except BaseException:
+            # A half-initialized client must not poison the next attempt
+            # (initialize raises "already initialized" otherwise).
+            try:
+                if is_distributed_initialized():
+                    jax.distributed.shutdown()
+            except Exception:
+                pass
+            raise
+
+    # Watchdog default = the overall deadline; IGG_WATCHDOG_S overrides it,
+    # and an explicit 0 disables (watchdog(0) is the off path).
+    wd_env = _config.watchdog_env()
+    with _resilience.watchdog(timeout_s if wd_env is None else wd_env):
+        _resilience.retry_call(
+            attempt,
+            retries=retries,
+            timeout_s=timeout_s,
+            base_backoff_s=backoff_s,
+            seed=process_id,
+            describe="jax.distributed.initialize",
+        )
     _owns_runtime = True
 
 
 def is_distributed_initialized() -> bool:
+    """Whether the multi-host runtime is up.
+
+    Prefers the private ``jax._src.distributed.global_state`` (the only
+    introspection older JAX offers) but degrades to the public
+    ``jax.distributed.is_initialized`` — a JAX upgrade that moves the
+    private module yields a clear error naming the missing APIs instead of
+    an AttributeError from deep inside.
+    """
     import jax
 
-    state = getattr(jax._src.distributed, "global_state", None)
-    return bool(state is not None and state.client is not None)
+    try:
+        state = getattr(jax._src.distributed, "global_state", None)
+    except AttributeError:
+        state = None
+    if state is not None:
+        return state.client is not None
+    public = getattr(getattr(jax, "distributed", None), "is_initialized", None)
+    if callable(public):
+        return bool(public())
+    raise RuntimeError(
+        "Cannot determine whether the JAX distributed runtime is "
+        "initialized: this JAX version exposes neither "
+        "jax._src.distributed.global_state nor "
+        "jax.distributed.is_initialized. Please report the installed JAX "
+        "version to implicitglobalgrid_tpu."
+    )
 
 
 def shutdown_distributed() -> None:

@@ -74,6 +74,22 @@ class GlobalGrid:
     def replace(self, **kw) -> "GlobalGrid":
         return dataclasses.replace(self, **kw)
 
+    def checkpoint_meta(self) -> dict:
+        """Topology metadata a checkpoint must match to be restorable here
+        (`utils.checkpoint`): the implicit-global-grid identity — local
+        sizes, dims, overlaps, periods — without runtime objects (mesh,
+        devices) that legitimately differ across restarts."""
+        return {
+            "dims": list(self.dims),
+            "nxyz": list(self.nxyz),
+            "nxyz_g": list(self.nxyz_g),
+            "overlaps": list(self.overlaps),
+            "periods": list(self.periods),
+            "disp": int(self.disp),
+            "nprocs": int(self.nprocs),
+            "device_type": self.device_type,
+        }
+
 
 _global_grid: GlobalGrid | None = None
 _epoch = 0
@@ -256,7 +272,15 @@ def init_global_grid(
         )
     if select_device:
         _select_device()
-    init_timing_functions()
+    # The first barrier is the first collective every process must enter: a
+    # straggler or mis-set coordinator hangs exactly here, in C++ where
+    # Python tracebacks see nothing — the IGG_WATCHDOG_S watchdog dumps
+    # all-thread stacks (and the env tier keeps it out of the hot loop).
+    from ..utils import config as _cfg
+    from ..utils.resilience import watchdog as _watchdog
+
+    with _watchdog(_cfg.watchdog_env()):
+        init_timing_functions()
     return me, dims, nprocs, coords, mesh
 
 
@@ -278,10 +302,12 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     from ..ops import gather as _gather
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
+    from ..utils import resilience as _resilience
 
     _halo._clear_caches()
     _stencil._clear_caches()
     _gather._clear_caches()
+    _resilience._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
     if finalize_distributed:
@@ -335,10 +361,12 @@ def _barrier() -> None:
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..utils.compat import shard_map
+
     gg = global_grid()
     if _barrier_fn is None or _barrier_fn[0] is not gg.mesh:
         mesh = gg.mesh
-        mapped = jax.shard_map(
+        mapped = shard_map(
             lambda: jnp.zeros((), jnp.int32),
             mesh=mesh,
             in_specs=(),

@@ -1,0 +1,37 @@
+"""JAX version compatibility shims.
+
+The framework targets the current public API (`jax.shard_map` with
+``check_vma``); older installs ship the same machinery as
+`jax.experimental.shard_map.shard_map` with the flag named ``check_rep``.
+Routing every call through this one adapter keeps a JAX up/downgrade a
+one-line concern instead of a scattered AttributeError hunt — the same
+degrade-to-a-clear-error contract `parallel.distributed.is_distributed_initialized`
+follows.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` across JAX versions.
+
+    ``check_vma`` maps onto the older ``check_rep`` — both flags gate the
+    same replication/varying-axes verification pass.
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError as e:  # pragma: no cover - no known JAX hits this
+        raise RuntimeError(
+            "This JAX version exposes neither jax.shard_map nor "
+            "jax.experimental.shard_map; implicitglobalgrid_tpu requires one "
+            "of the two (jax >= 0.4.30 or newer)."
+        ) from e
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )

@@ -22,25 +22,131 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_VMEM_MB``           per-core VMEM capacity the fused kernels plan
                           against (`ops._fused_envelope.vmem_budget` — read
                           per kernel build, not at init)
+``IGG_INIT_RETRIES``      retry attempts for `init_distributed`'s runtime
+                          bring-up (int >= 0, default 3; coordinator races
+                          are the #1 multi-host bring-up failure) — read
+                          per call by `parallel.distributed.init_distributed`
+``IGG_INIT_TIMEOUT_S``    overall deadline in seconds across all bring-up
+                          attempts (number > 0, default 600)
+``IGG_INIT_BACKOFF_S``    base of the exponential retry backoff in seconds
+                          (number > 0, default 1; doubles per attempt with
+                          seeded jitter — `utils.resilience.backoff_schedule`)
+``IGG_WATCHDOG_S``        collective-hang watchdog: dump all-thread stacks
+                          after this many seconds during grid/runtime
+                          bring-up.  Unset = off around the init barrier but
+                          `init_distributed` defaults to its bring-up
+                          deadline; 0 = off everywhere
+
+``IGG_GUARD_EVERY``       default ``guard_every`` for the models' time loops
+                          (int >= 0; 0 = guards off) — run the NaN/Inf
+                          field probe every N steps (`igg.check_fields`)
+``IGG_GUARD_POLICY``      what a tripped guard does: ``raise`` (default) |
+                          ``warn`` | ``rollback`` (restore last good state)
+``IGG_CHECKPOINT_EVERY``  default checkpoint cadence for the models' time
+                          loops (int >= 0; 0 = off)
+``IGG_CHECKPOINT_DIR``    default checkpoint directory (`utils.checkpoint`)
+``IGG_FAULT_INJECT``      fault-injection knob for the test/soak harness:
+                          ``init_flake:N`` | ``halo_corrupt:stepN[:procP]``
+                          | ``worker_crash:stepN[:procP]`` (docs/robustness.md)
 ========================  ====================================================
 
-Explicit `init_global_grid` kwargs always win over env values; env values win
-over built-in defaults — the reference's precedence.
+Explicit kwargs always win over env values; env values win over built-in
+defaults — the reference's precedence.  The resilience knobs are read per
+call (like ``IGG_DONATE``), not snapshotted at init.
 """
 
 from __future__ import annotations
 
 import os
 
+#: Valid values for ``IGG_GUARD_POLICY`` / the models' ``guard_policy``.
+GUARD_POLICIES = ("raise", "warn", "rollback")
 
-def _int_env(name: str) -> int | None:
+
+def _int_env(name: str, *, minimum: int | None = None, maximum: int | None = None) -> int | None:
+    """Read an integer env var; ``None`` when unset/empty.
+
+    Error messages follow the reference's contract (name the variable and
+    the obtained value) and state the accepted range/format.
+    """
     val = os.environ.get(name)
     if val is None or val == "":
         return None
     try:
-        return int(val)
+        parsed = int(val)
     except ValueError:
-        raise ValueError(f"Environment variable {name} must be an integer, got {val!r}.")
+        raise ValueError(
+            f"Environment variable {name} must be an integer"
+            f"{_range_desc(minimum, maximum)} (format: a base-10 integer), "
+            f"got {val!r}."
+        )
+    _check_range(name, parsed, minimum, maximum, val)
+    return parsed
+
+
+def _float_env(
+    name: str,
+    *,
+    minimum: float | None = None,
+    exclusive_minimum: float | None = None,
+) -> float | None:
+    """Read a float env var; ``None`` when unset/empty.  Same error contract
+    as `_int_env` (variable name, accepted range, obtained value)."""
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return None
+    try:
+        parsed = float(val)
+    except ValueError:
+        raise ValueError(
+            f"Environment variable {name} must be a number"
+            f"{_range_desc(minimum, None, exclusive_minimum)} "
+            f"(format: a decimal number of seconds, e.g. '2' or '0.5'), "
+            f"got {val!r}."
+        )
+    _check_range(name, parsed, minimum, None, val, exclusive_minimum)
+    return parsed
+
+
+def _choice_env(name: str, choices: tuple[str, ...]) -> str | None:
+    """Read an enumerated env var; ``None`` when unset/empty."""
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return None
+    if val not in choices:
+        raise ValueError(
+            f"Environment variable {name} must be one of "
+            f"{', '.join(repr(c) for c in choices)}, got {val!r}."
+        )
+    return val
+
+
+def _range_desc(minimum, maximum, exclusive_minimum=None) -> str:
+    if exclusive_minimum is not None:
+        return f" > {exclusive_minimum}"
+    if minimum is not None and maximum is not None:
+        return f" in [{minimum}, {maximum}]"
+    if minimum == 0:
+        return " >= 0 (non-negative)"
+    if minimum is not None:
+        return f" >= {minimum}"
+    if maximum is not None:
+        return f" <= {maximum}"
+    return ""
+
+
+def _check_range(name, parsed, minimum, maximum, val, exclusive_minimum=None):
+    bad = (
+        (minimum is not None and parsed < minimum)
+        or (maximum is not None and parsed > maximum)
+        or (exclusive_minimum is not None and parsed <= exclusive_minimum)
+    )
+    if bad:
+        kind = "an integer" if isinstance(parsed, int) else "a number"
+        raise ValueError(
+            f"Environment variable {name} must be {kind}"
+            f"{_range_desc(minimum, maximum, exclusive_minimum)}, got {val!r}."
+        )
 
 
 def env_config() -> dict:
@@ -59,3 +165,62 @@ def env_config() -> dict:
     if overlap is not None:
         cfg["overlap"] = overlap
     return cfg
+
+
+# -- Resilience knobs (read per call, like IGG_DONATE) ------------------------
+#
+# Each accessor validates the reference's error contract: negative retries,
+# zero/negative timeouts and unknown policies are rejected with a message
+# naming the variable, the accepted range and the obtained value.
+
+
+def init_retries_env() -> int | None:
+    """``IGG_INIT_RETRIES``: retry attempts after the first bring-up failure."""
+    return _int_env("IGG_INIT_RETRIES", minimum=0)
+
+
+def init_timeout_env() -> float | None:
+    """``IGG_INIT_TIMEOUT_S``: overall bring-up deadline in seconds (> 0)."""
+    return _float_env("IGG_INIT_TIMEOUT_S", exclusive_minimum=0)
+
+
+def init_backoff_env() -> float | None:
+    """``IGG_INIT_BACKOFF_S``: base retry backoff in seconds (> 0)."""
+    return _float_env("IGG_INIT_BACKOFF_S", exclusive_minimum=0)
+
+
+def watchdog_env() -> float | None:
+    """``IGG_WATCHDOG_S``: collective-hang watchdog in seconds (>= 0).
+
+    ``None`` = unset (caller picks its default), ``0.0`` = explicitly off —
+    the distinction lets an explicit 0 disable a watchdog a caller would
+    otherwise arm with its own fallback timeout.
+    """
+    return _float_env("IGG_WATCHDOG_S", minimum=0)
+
+
+def guard_every_env() -> int | None:
+    """``IGG_GUARD_EVERY``: NaN/Inf guard cadence in steps (>= 0; 0 = off)."""
+    return _int_env("IGG_GUARD_EVERY", minimum=0)
+
+
+def guard_policy_env() -> str | None:
+    """``IGG_GUARD_POLICY``: ``raise`` | ``warn`` | ``rollback``."""
+    return _choice_env("IGG_GUARD_POLICY", GUARD_POLICIES)
+
+
+def checkpoint_every_env() -> int | None:
+    """``IGG_CHECKPOINT_EVERY``: checkpoint cadence in steps (>= 0; 0 = off)."""
+    return _int_env("IGG_CHECKPOINT_EVERY", minimum=0)
+
+
+def checkpoint_dir_env() -> str | None:
+    """``IGG_CHECKPOINT_DIR``: default checkpoint directory."""
+    val = os.environ.get("IGG_CHECKPOINT_DIR")
+    return val or None
+
+
+def fault_inject_env() -> str | None:
+    """``IGG_FAULT_INJECT``: raw fault spec (parsed by `utils.resilience`)."""
+    val = os.environ.get("IGG_FAULT_INJECT")
+    return val or None

@@ -117,7 +117,9 @@ def from_block_fn(fn, local_shape, dtype=None):
             per_block, out_shardings=SingleDeviceSharding(gg.mesh.devices.flat[0])
         )()
 
-    mapped = jax.shard_map(
+    from .compat import shard_map
+
+    mapped = shard_map(
         per_block,
         mesh=gg.mesh,
         in_specs=(),
@@ -196,8 +198,10 @@ def block_slice(A, slices):
             per_block, out_shardings=SingleDeviceSharding(gg.mesh.devices.flat[0])
         )(A)
 
+    from .compat import shard_map
+
     spec = P(*AXIS_NAMES[:nd])
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_block, mesh=gg.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )
     return jax.jit(mapped)(A)

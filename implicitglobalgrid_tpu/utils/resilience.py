@@ -1,0 +1,850 @@
+"""Resilience layer: guarded bring-up, numerical guards, fault injection.
+
+The reference's whole value proposition is that the 3-function API survives
+scaling to thousands of processes (`/root/reference/README.md:12`); at that
+scale the failures that dominate are not stencil bugs but *runtime* faults:
+coordinator races during multi-host bring-up, a NaN born in one block
+silently flooding the global grid through `update_halo`, and preempted
+workers losing whole simulations.  This module is the robustness layer a
+production stack ships first:
+
+* **Guarded bring-up** — `retry_call` / `backoff_schedule` give
+  `parallel.distributed.init_distributed` configurable retry with
+  exponential backoff + seeded jitter and an overall deadline
+  (``IGG_INIT_RETRIES`` / ``IGG_INIT_TIMEOUT_S`` / ``IGG_INIT_BACKOFF_S``);
+  `watchdog` dumps all-thread stacks when a collective hangs (generalizing
+  what ``tests/_distributed_worker.py`` hand-rolled).
+* **Numerical guards** — `check_fields` runs ONE cheap jitted all-reduce
+  isnan/isinf probe per guard point and reports the offending *block
+  coordinates*; `RunGuard` applies the ``raise`` | ``warn`` | ``rollback``
+  policy inside the models' time loops (``guard_every=N``).
+* **Fault injection** — `FaultInjector` parses ``IGG_FAULT_INJECT``
+  (``init_flake:N``, ``halo_corrupt:stepN[:blockB]``,
+  ``worker_crash:stepN[:procP]``) so the 2-process `test_distributed.py`
+  path and `scripts/soak.py` can prove crash→restart-from-checkpoint and
+  corruption→guard-trip end to end.
+
+Checkpoint/restart itself lives in `utils.checkpoint`; `RunGuard` drives it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import faulthandler
+import os
+import random
+import sys
+import time
+import warnings
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..parallel import grid as _grid
+from ..parallel.topology import AXIS_NAMES, NDIMS
+from . import config as _config
+
+__all__ = [
+    "GuardError",
+    "FieldReport",
+    "RunGuard",
+    "FaultInjector",
+    "backoff_schedule",
+    "retry_call",
+    "watchdog",
+    "check_fields",
+    "get_fault_injector",
+    "reset_fault_injector",
+    "snapshot_state",
+]
+
+
+# -- Guarded bring-up ---------------------------------------------------------
+
+#: Built-in defaults of the init retry tier (kwarg > ``IGG_*`` env > these).
+DEFAULT_INIT_RETRIES = 3
+DEFAULT_INIT_TIMEOUT_S = 600.0
+DEFAULT_INIT_BACKOFF_S = 1.0
+_BACKOFF_CAP_S = 30.0
+
+
+def backoff_schedule(
+    retries: int,
+    *,
+    base_s: float = DEFAULT_INIT_BACKOFF_S,
+    cap_s: float = _BACKOFF_CAP_S,
+    jitter: float = 0.5,
+    seed: int | None = None,
+) -> list[float]:
+    """Exponential backoff delays for ``retries`` re-attempts.
+
+    Delay ``i`` is ``min(base * 2**i, cap)`` stretched by a uniform jitter in
+    ``[1, 1 + jitter]`` — jitter de-synchronizes thousands of workers
+    hammering a coordinator after a correlated failure (the thundering-herd
+    fix), and seeding it makes schedules reproducible in tests.  ``seed``
+    defaults to this process's index when the runtime is up; during bring-up
+    `init_distributed` passes its ``process_id`` through instead (an
+    auto-detected pod without one falls back to a shared seed — spread-out
+    retries need the explicit id).
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0 (got {retries})")
+    if base_s <= 0 or cap_s <= 0:
+        raise ValueError(f"base_s and cap_s must be > 0 (got {base_s}, {cap_s})")
+    if jitter < 0:
+        raise ValueError(f"jitter must be >= 0 (got {jitter})")
+    if seed is None:
+        seed = _safe_process_index()
+    rng = random.Random(seed)
+    return [
+        min(base_s * (2.0**i), cap_s) * (1.0 + rng.uniform(0.0, jitter))
+        for i in range(retries)
+    ]
+
+
+def _safe_process_index() -> int:
+    """Process index without touching the (possibly absent) runtime."""
+    try:
+        import jax
+
+        from ..parallel import distributed as _dist
+
+        if _dist.is_distributed_initialized():
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    *,
+    retries: int = DEFAULT_INIT_RETRIES,
+    timeout_s: float | None = DEFAULT_INIT_TIMEOUT_S,
+    base_backoff_s: float = DEFAULT_INIT_BACKOFF_S,
+    jitter: float = 0.5,
+    seed: int | None = None,
+    describe: str = "operation",
+    on_retry: Callable[[int, BaseException, float], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+) -> Any:
+    """Call ``fn`` with up to ``retries`` re-attempts under a deadline.
+
+    ``timeout_s`` is an *overall* deadline across attempts: a retry whose
+    backoff would cross it is not taken (a hang inside one attempt cannot be
+    interrupted from Python — arm `watchdog` for that).  ``on_retry(attempt,
+    error, delay)`` observes each failure; the default logs to stderr.
+    Raises the last error, annotated with the attempt count and deadline.
+    """
+    if timeout_s is not None and timeout_s <= 0:
+        raise ValueError(f"timeout_s must be > 0 (got {timeout_s})")
+    delays = backoff_schedule(
+        retries, base_s=base_backoff_s, jitter=jitter, seed=seed
+    )
+    t0 = clock()
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except (KeyboardInterrupt, SystemExit, GeneratorExit):
+            # Deliberate shutdown is not a flaky bring-up: never retry it.
+            raise
+        except BaseException as e:
+            last = e
+            if attempt >= retries:
+                break
+            delay = delays[attempt]
+            elapsed = clock() - t0
+            if timeout_s is not None and elapsed + delay > timeout_s:
+                raise RuntimeError(
+                    f"{describe} failed after {attempt + 1} attempt(s) in "
+                    f"{elapsed:.1f}s; the overall deadline "
+                    f"(timeout_s={timeout_s}, IGG_INIT_TIMEOUT_S) leaves no "
+                    f"room for another retry. Last error: {e!r}"
+                ) from e
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            else:
+                print(
+                    f"[igg.resilience] {describe} attempt {attempt + 1}/"
+                    f"{retries + 1} failed ({e!r}); retrying in {delay:.2f}s",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            sleep(delay)
+    raise RuntimeError(
+        f"{describe} failed after {retries + 1} attempt(s) "
+        f"(retries={retries}, IGG_INIT_RETRIES). Last error: {last!r}"
+    ) from last
+
+
+# faulthandler keeps ONE process-wide timer; this stack makes nested
+# watchdogs well-behaved AND strictest-wins: arming/exiting re-arms with the
+# SMALLEST timeout on the stack and the OR of the exit flags, so an inner
+# watchdog with a laxer deadline (e.g. init_distributed's 600 s default
+# inside a test worker's 270 s exit=True watchdog) can never weaken the
+# enclosing one.  Each re-arm restarts the timer, so an outer deadline can
+# extend by at most one inner scope's duration — bounded, and strictly
+# tighter than the pre-stack behavior (inner exit silently DISARMED the
+# outer watchdog entirely).
+_watchdog_stack: list[tuple[float, bool, Any]] = []
+
+
+def arm_watchdog(timeout_s: float, *, exit: bool = False, file=None) -> None:
+    """Arm the stack-dump watchdog for the remaining process lifetime.
+
+    For linear scripts (test workers) where a ``with`` block is awkward;
+    pair with `disarm_watchdog` or let it ride until process exit.
+    """
+    _watchdog_stack.append((float(timeout_s), exit, file))
+    _rearm()
+
+
+def disarm_watchdog() -> None:
+    _watchdog_stack.pop() if _watchdog_stack else None
+    _rearm()
+
+
+def _rearm() -> None:
+    if not _watchdog_stack:
+        faulthandler.cancel_dump_traceback_later()
+        return
+    # The entry whose deadline will actually fire supplies the dump stream.
+    timeout_s, _, file = min(_watchdog_stack, key=lambda e: e[0])
+    kwargs = {"exit": any(e for _, e, _ in _watchdog_stack)}
+    if file is not None:
+        kwargs["file"] = file
+    faulthandler.dump_traceback_later(timeout_s, **kwargs)
+
+
+@contextlib.contextmanager
+def watchdog(timeout_s: float | None, *, exit: bool = False, file=None):
+    """Dump all-thread stack traces if the enclosed block runs past ``timeout_s``.
+
+    The collective-hang debugging tool: a deadlocked `psum`/`ppermute` (one
+    process missing from a collective) blocks in C++ where Python sees
+    nothing — `faulthandler.dump_traceback_later` fires from a watchdog
+    thread and shows every thread's stack, and ``exit=True`` also kills the
+    process so an orchestrator can restart it (generalizes the hand-rolled
+    watchdog in ``tests/_distributed_worker.py``).  ``timeout_s=None``/0
+    disarms (the ``IGG_WATCHDOG_S``-unset path).  faulthandler keeps one
+    process-wide timer, so nesting is strictest-wins: the smallest timeout
+    on the watchdog stack is armed and ``exit`` flags OR together — an
+    inner watchdog can tighten but never weaken an enclosing one.
+    """
+    if not timeout_s:
+        yield
+        return
+    arm_watchdog(timeout_s, exit=exit, file=file)
+    try:
+        yield
+    finally:
+        disarm_watchdog()
+
+
+# -- Numerical guards ---------------------------------------------------------
+
+
+class GuardError(RuntimeError):
+    """A NaN/Inf guard tripped.  Carries the step and the offending blocks."""
+
+    def __init__(self, message: str, *, step: int | None = None, report=None):
+        super().__init__(message)
+        self.step = step
+        self.report = report
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldReport:
+    """Result of one `check_fields` probe.
+
+    ``bad_blocks`` maps field name -> tuple of block ``coords`` (Cartesian
+    mesh coordinates, the reference's ``coords``) holding at least one
+    non-finite value.  Replicated across processes: every rank sees the
+    same report and can take the same policy action.
+    """
+
+    names: tuple[str, ...]
+    bad_blocks: dict[str, tuple[tuple[int, ...], ...]]
+
+    @property
+    def ok(self) -> bool:
+        return not self.bad_blocks
+
+    def summary(self) -> str:
+        if self.ok:
+            return f"all finite ({', '.join(self.names)})"
+        parts = [
+            f"{name}: block(s) {', '.join(str(c) for c in coords)}"
+            for name, coords in self.bad_blocks.items()
+        ]
+        return "non-finite values in " + "; ".join(parts)
+
+
+_probe_cache: dict = {}
+
+
+def _clear_caches() -> None:
+    _probe_cache.clear()
+
+
+def _probe_fn(gg, shapes_dtypes):
+    """Build (and cache) the jitted per-block finite probe.
+
+    One program per (epoch, signature): each block reduces its fields to a
+    per-field bad flag, scatters it into a ``dims``-shaped one-hot and
+    `psum`s over all mesh axes — the result is a tiny REPLICATED
+    ``(nfields, *dims)`` flag array every process can read without extra
+    communication (the all-reduce rides the same compiled collectives as a
+    step).  Cost: one elementwise isfinite pass + an all-reduce of
+    ``nfields * prod(dims)`` int32s.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map
+
+    key = (gg.epoch, shapes_dtypes)
+    fn = _probe_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def block_flags(*fields):
+        flags = []
+        for A in fields:
+            if jnp.issubdtype(A.dtype, jnp.inexact):
+                bad = jnp.any(~jnp.isfinite(A)).astype(jnp.int32)
+            else:
+                bad = jnp.int32(0)  # integer fields cannot hold NaN/Inf
+            flags.append(bad)
+        return jnp.stack(flags)
+
+    if gg.nprocs == 1 and not gg.force_spmd:
+        fn = jax.jit(lambda *f: block_flags(*f).reshape((len(shapes_dtypes), 1, 1, 1)))
+        _probe_cache[key] = fn
+        return fn
+
+    def per_block(*fields):
+        flags = block_flags(*fields)  # (nfields,)
+        onehot = jnp.zeros((len(shapes_dtypes), *gg.dims), jnp.int32)
+        for i, (shp, _) in enumerate(shapes_dtypes):
+            # Coordinates only over the FIELD's own dimensions: a lower-rank
+            # field is replicated along the remaining mesh axes, and using
+            # the device's full 3-D coords would report one phantom bad
+            # block per replica (the replicas scatter at distinct cz).
+            # Clamping those axes to 0 makes every replica-holding device
+            # scatter at the same logical coords (the count psums up > 1,
+            # which argwhere treats the same as 1).
+            coords = tuple(
+                lax.axis_index(AXIS_NAMES[d])
+                if d < len(shp) and gg.dims[d] > 1
+                else jnp.int32(0)
+                for d in range(NDIMS)
+            )
+            onehot = lax.dynamic_update_slice(
+                onehot,
+                flags[i].reshape((1, 1, 1, 1)),
+                (jnp.int32(i), *coords),
+            )
+        # psum over every mesh axis -> replicated on all devices/processes.
+        return lax.psum(onehot, AXIS_NAMES)
+
+    specs = tuple(P(*AXIS_NAMES[: len(s)]) for s, _ in shapes_dtypes)
+    mapped = shard_map(
+        per_block, mesh=gg.mesh, in_specs=specs, out_specs=P(), check_vma=False
+    )
+    fn = jax.jit(mapped)
+    _probe_cache[key] = fn
+    return fn
+
+
+def check_fields(*fields, names: Sequence[str] | None = None) -> FieldReport:
+    """Probe global-block field(s) for NaN/Inf; report offending blocks.
+
+    The numerical-guard API (`igg.check_fields`): one cheap jitted
+    all-reduce isnan/isinf pass over the given fields.  Returns a
+    `FieldReport` whose ``bad_blocks`` names the Cartesian ``coords`` of
+    every block holding a non-finite value — the information an operator
+    needs to localize the fault on a pod (which host, which block), which a
+    plain ``jnp.isnan(A).any()`` on the global array cannot give.
+
+    Works on concrete global-block arrays (the models' time loops, any
+    host-side loop).  Multi-host safe: the probe result is replicated, so
+    every process sees the same report and the ``rollback`` policy cannot
+    diverge across ranks.
+    """
+    from ..ops.halo import local_shape
+
+    _grid.check_initialized()
+    gg = _grid.global_grid()
+    if not fields:
+        raise ValueError("check_fields requires at least one field.")
+    if names is None:
+        names = tuple(f"field{i}" for i in range(len(fields)))
+    else:
+        names = tuple(names)
+        if len(names) != len(fields):
+            raise ValueError(
+                f"names has {len(names)} entries for {len(fields)} fields."
+            )
+    sig = tuple((local_shape(A, gg), str(A.dtype)) for A in fields)
+    flags = np.asarray(_probe_fn(gg, sig)(*fields))
+    bad: dict[str, tuple[tuple[int, ...], ...]] = {}
+    for i, name in enumerate(names):
+        coords = tuple(tuple(int(c) for c in idx) for idx in np.argwhere(flags[i]))
+        if coords:
+            bad[name] = coords
+    return FieldReport(names=names, bad_blocks=bad)
+
+
+# -- Fault injection ----------------------------------------------------------
+
+FAULT_KINDS = ("init_flake", "halo_corrupt", "worker_crash")
+
+
+@dataclasses.dataclass
+class FaultInjector:
+    """Deterministic fault injection, armed via ``IGG_FAULT_INJECT``.
+
+    Spec grammar (see docs/robustness.md):
+
+    * ``init_flake:N`` — the first ``N`` `init_distributed` attempts raise
+      (simulated coordinator race); attempt ``N+1`` proceeds.  Proves the
+      retry/backoff path end to end.
+    * ``halo_corrupt:stepN[:blockB]`` — after time-loop step ``N``, a NaN is
+      written into an interior cell of block ``B`` (Cartesian rank, default
+      0).  Every process executes the same scatter (the target index is
+      derived from the block's coords, which all ranks can compute), so the
+      injection stays SPMD-consistent on multi-host runs.  Proves
+      corruption→guard-trip.
+    * ``worker_crash:stepN[:procP]`` — after time-loop step ``N`` (and after
+      that step's checkpoint), process ``P`` (default: the last process)
+      exits hard with status 17.  Proves crash→restart-from-checkpoint.
+
+    Each fault fires once per injector (a rolled-back or restarted run does
+    not re-trip), mirroring how real transient faults behave.
+    """
+
+    kind: str | None = None
+    step: int | None = None
+    target: int | None = None  # halo_corrupt: block rank; worker_crash: process
+    count: int = 0  # init_flake: remaining flaky attempts
+    fired: bool = False
+
+    #: exit status of an injected worker crash (distinct from real crashes)
+    CRASH_STATUS = 17
+
+    @classmethod
+    def from_spec(cls, spec: str | None) -> "FaultInjector":
+        if not spec:
+            return cls()
+        parts = spec.split(":")
+        kind = parts[0]
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"IGG_FAULT_INJECT: unknown fault kind {kind!r} in {spec!r}; "
+                f"accepted kinds: {', '.join(FAULT_KINDS)} (format: "
+                f"'init_flake:N' or 'halo_corrupt:stepN[:blockB]' or "
+                f"'worker_crash:stepN[:procP]')."
+            )
+        if kind == "init_flake":
+            if len(parts) != 2 or not parts[1].isdigit():
+                raise ValueError(
+                    f"IGG_FAULT_INJECT: {spec!r} — init_flake takes "
+                    f"'init_flake:N' with N a non-negative integer count of "
+                    f"attempts to fail."
+                )
+            return cls(kind=kind, count=int(parts[1]))
+        tgt_prefix = "block" if kind == "halo_corrupt" else "proc"
+        if len(parts) not in (2, 3) or not parts[1].startswith("step"):
+            raise ValueError(
+                f"IGG_FAULT_INJECT: {spec!r} — {kind} takes "
+                f"'{kind}:stepN[:{tgt_prefix}P]' with N the 1-based "
+                f"time-loop step."
+            )
+        try:
+            step = int(parts[1][len("step"):])
+        except ValueError:
+            raise ValueError(
+                f"IGG_FAULT_INJECT: {spec!r} — step must be an integer, "
+                f"got {parts[1][len('step'):]!r}."
+            )
+        target = None
+        if len(parts) == 3:
+            if not parts[2].startswith(tgt_prefix):
+                raise ValueError(
+                    f"IGG_FAULT_INJECT: {spec!r} — the third component must "
+                    f"be '{tgt_prefix}P' with P "
+                    + (
+                        "a block rank."
+                        if kind == "halo_corrupt"
+                        else "a process index."
+                    )
+                )
+            try:
+                target = int(parts[2][len(tgt_prefix):])
+            except ValueError:
+                raise ValueError(
+                    f"IGG_FAULT_INJECT: {spec!r} — {tgt_prefix} must be an "
+                    f"integer, got {parts[2][len(tgt_prefix):]!r}."
+                )
+        return cls(kind=kind, step=step, target=target)
+
+    @property
+    def active(self) -> bool:
+        return self.kind is not None
+
+    # - init_flake -
+
+    def maybe_flake_init(self) -> None:
+        """Raise a simulated coordinator race while flaky attempts remain."""
+        if self.kind == "init_flake" and self.count > 0:
+            self.count -= 1
+            raise RuntimeError(
+                "IGG_FAULT_INJECT(init_flake): simulated coordinator race "
+                f"({self.count} flaky attempt(s) remaining)"
+            )
+
+    # - halo_corrupt -
+
+    def maybe_corrupt(self, state: tuple, step: int) -> tuple:
+        """After step ``step``: NaN-poison one interior cell of the target block.
+
+        Runs identically on EVERY process (same scatter, same global index),
+        so multi-host programs stay SPMD-consistent; only the target block's
+        owner actually holds the poisoned cell.
+        """
+        if self.kind != "halo_corrupt" or self.fired or step != self.step:
+            return state
+        self.fired = True
+        A = self._poison_block(state[0], announce_step=step)
+        return (A, *state[1:])
+
+    def _poison_block(self, A, announce_step=None):
+        import jax.numpy as jnp
+
+        idx = _block_interior_index(A, self.target or 0)
+        if _safe_process_index() == 0:
+            at = "" if announce_step is None else f" after step {announce_step}"
+            print(
+                f"[igg.resilience] IGG_FAULT_INJECT(halo_corrupt): writing "
+                f"NaN into global index {tuple(idx)} (block "
+                f"{self.target or 0}){at}",
+                file=sys.stderr,
+                flush=True,
+            )
+        return A.at[idx].set(jnp.nan)
+
+    def corrupt_halo_hook(self, fields: tuple) -> tuple:
+        """`ops.halo` post-exchange hook: poison direct `update_halo` output.
+
+        Step-agnostic (direct halo calls carry no step): fires on the first
+        exchange after arming.  Installed by the pytest ``fault_injection``
+        fixture / `install_halo_fault_hook`.
+        """
+        if self.kind != "halo_corrupt" or self.fired:
+            return fields
+        self.fired = True
+        return (self._poison_block(fields[0]), *fields[1:])
+
+    # - worker_crash -
+
+    def maybe_crash(self, step: int) -> None:
+        """After step ``step``'s guard+checkpoint: hard-exit this process."""
+        if self.kind != "worker_crash" or self.fired or step != self.step:
+            return  # cheap short-circuit: this runs every step of every loop
+        want = self.target if self.target is not None else _last_process_index()
+        if _safe_process_index() != want:
+            return
+        self.fired = True
+        print(
+            f"[igg.resilience] IGG_FAULT_INJECT(worker_crash): exiting hard "
+            f"after step {step} (status {self.CRASH_STATUS})",
+            file=sys.stderr,
+            flush=True,
+        )
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(self.CRASH_STATUS)
+
+
+def _last_process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_count() - 1
+    except Exception:
+        return 0
+
+
+def _block_interior_index(A, block_rank: int) -> tuple:
+    """A global index inside block ``block_rank`` of global-block field ``A``,
+    one cell off the block edge (so frozen boundary rings don't mask it and
+    the models' interior updates propagate it).  Derived purely from the grid
+    topology — every process computes the same index."""
+    from ..ops.halo import local_shape
+    from ..parallel import topology
+
+    gg = _grid.global_grid()
+    if not 0 <= block_rank < gg.nprocs:
+        raise ValueError(
+            f"IGG_FAULT_INJECT(halo_corrupt): block {block_rank} is out of "
+            f"range for this grid ({gg.nprocs} blocks, dims {gg.dims})."
+        )
+    coords = topology.coords_of_rank(block_rank, gg.dims)
+    lsh = local_shape(A, gg)
+    return tuple(
+        c * n + min(1, n - 1) for c, n in zip(coords[: len(lsh)], lsh)
+    )
+
+
+_injector: FaultInjector | None = None
+_injector_spec: str | None = None
+
+
+def get_fault_injector() -> FaultInjector:
+    """The process-wide injector for the current ``IGG_FAULT_INJECT`` value.
+
+    Cached per spec string so fired/remaining state persists across calls;
+    changing the env var re-arms automatically, `reset_fault_injector`
+    re-arms explicitly (the pytest fixture path).
+    """
+    global _injector, _injector_spec
+    spec = _config.fault_inject_env()
+    if _injector is None or spec != _injector_spec:
+        _injector = FaultInjector.from_spec(spec)
+        _injector_spec = spec
+    return _injector
+
+
+def reset_fault_injector() -> None:
+    global _injector, _injector_spec
+    _injector = None
+    _injector_spec = None
+
+
+def install_halo_fault_hook() -> None:
+    """Wire the active injector into `ops.halo`'s post-exchange hook point."""
+    from ..ops import halo as _halo
+
+    inj = get_fault_injector()
+    _halo.set_post_exchange_hook(inj.corrupt_halo_hook if inj.active else None)
+
+
+# -- Run guard (the models' time-loop hook) -----------------------------------
+
+
+_copy_jit = None
+
+
+def snapshot_state(state: tuple) -> tuple:
+    """Device-side bit-exact copy of a state tuple (fresh buffers).
+
+    A plain reference is not enough for rollback: the models' step functions
+    donate their inputs, so the snapshot must own separate buffers.  `jnp.copy`
+    under jit produces a genuine device copy with the input's sharding.
+    """
+    global _copy_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _copy_jit is None:
+        _copy_jit = jax.jit(jnp.copy)
+    return tuple(_copy_jit(A) for A in state)
+
+
+def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard", sync_every_step: bool = False) -> tuple:
+    """The models' host-side time loop with the guard pipeline attached.
+
+    Resumes from the guard's checkpoint dir when one exists, then advances
+    to step ``nt``, running `RunGuard.on_step` after every step (fault
+    injection → NaN/Inf guard → checkpoint → crash injection; rollback may
+    rewind the loop variable).  Shared by the three models' ``run()`` so the
+    guard semantics cannot drift between them.
+    """
+    import jax
+
+    state, it = guard.start(state)
+    enabled = guard.enabled  # skip the per-step pipeline entirely when idle
+    if it > nt:
+        # A checkpoint past the requested horizon is almost always a stale
+        # directory (e.g. a previous longer run) — returning it silently
+        # would mislabel old physics as this run's result.
+        warnings.warn(
+            f"resumed checkpoint is at step {it}, past the requested "
+            f"nt={nt}; returning the checkpointed state unchanged (stale "
+            f"checkpoint_dir?)",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    while it < nt:
+        state = step_fn(*state)
+        if sync_every_step:
+            jax.block_until_ready(state)
+        it += 1
+        if enabled:
+            state, it = guard.on_step(state, it)
+    return state
+
+
+class RunGuard:
+    """Guard + checkpoint + fault-injection driver for a host-side time loop.
+
+    Used by the three models' ``run()`` loops::
+
+        guard = RunGuard(guard_every=10, policy="rollback",
+                         checkpoint_every=100, checkpoint_dir="/ckpt",
+                         names=("T", "Cp"))
+        state, it = guard.start(state)
+        while it < nt:
+            state = step(*state)
+            it += 1
+            state, it = guard.on_step(state, it)
+
+    Per step, in order: (1) fault injection (``halo_corrupt``), (2) the
+    NaN/Inf guard every ``guard_every`` steps with the ``raise`` | ``warn``
+    | ``rollback`` policy, (3) checkpoint every ``checkpoint_every`` steps
+    (only ever of guard-passed state), (4) fault injection
+    (``worker_crash`` — after the checkpoint, so restart resumes exactly at
+    the crash point).  Rollback restores the last good snapshot (in-memory;
+    the disk checkpoint serves cross-process restart) and rewinds ``it``.
+
+    All knobs resolve kwarg > ``IGG_*`` env > default (the reference's
+    configuration tiers).
+    """
+
+    def __init__(
+        self,
+        *,
+        guard_every: int | None = None,
+        policy: str | None = None,
+        checkpoint_every: int | None = None,
+        checkpoint_dir: str | None = None,
+        names: Sequence[str] | None = None,
+        max_rollbacks: int = 3,
+        injector: FaultInjector | None = None,
+    ):
+        env_ge = _config.guard_every_env()
+        env_pol = _config.guard_policy_env()
+        env_ce = _config.checkpoint_every_env()
+        env_dir = _config.checkpoint_dir_env()
+        self.guard_every = int(
+            guard_every if guard_every is not None else (env_ge or 0)
+        )
+        self.policy = policy if policy is not None else (env_pol or "raise")
+        self.checkpoint_every = int(
+            checkpoint_every if checkpoint_every is not None else (env_ce or 0)
+        )
+        self.checkpoint_dir = (
+            checkpoint_dir if checkpoint_dir is not None else env_dir
+        )
+        if self.guard_every < 0:
+            raise ValueError(f"guard_every must be >= 0 (got {self.guard_every})")
+        if self.checkpoint_every < 0:
+            raise ValueError(
+                f"checkpoint_every must be >= 0 (got {self.checkpoint_every})"
+            )
+        if self.policy not in _config.GUARD_POLICIES:
+            raise ValueError(
+                f"guard policy must be one of "
+                f"{', '.join(map(repr, _config.GUARD_POLICIES))}, got {self.policy!r}."
+            )
+        if self.checkpoint_every and not self.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_every > 0 requires a checkpoint_dir (kwarg or "
+                "IGG_CHECKPOINT_DIR)."
+            )
+        self.names = tuple(names) if names is not None else None
+        self.max_rollbacks = max_rollbacks
+        self.rollbacks = 0
+        self._last_good: tuple | None = None
+        self._last_good_step = 0
+        self._injector = injector if injector is not None else get_fault_injector()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(
+            self.guard_every or self.checkpoint_every or self._injector.active
+        )
+
+    def start(self, state: tuple) -> tuple:
+        """Resume from the latest checkpoint if one exists, else step 0.
+
+        Returns ``(state, start_step)``.  The step-0 state is snapshotted as
+        the initial rollback target when the policy needs one.
+        """
+        it = 0
+        if self.checkpoint_dir:
+            from . import checkpoint as _ckpt
+
+            latest = _ckpt.latest_checkpoint(self.checkpoint_dir)
+            if latest is not None:
+                state, it, _ = _ckpt.restore_checkpoint(latest, like=state)
+                print(
+                    f"[igg.resilience] resumed from checkpoint {latest} "
+                    f"(step {it})",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        if self.policy == "rollback" and self.guard_every:
+            self._last_good = snapshot_state(state)
+            self._last_good_step = it
+        return state, it
+
+    def on_step(self, state: tuple, it: int) -> tuple:
+        """Run the per-step guard pipeline; returns ``(state, it)``."""
+        state = self._injector.maybe_corrupt(state, it)
+        do_guard = self.guard_every and it % self.guard_every == 0
+        do_ckpt = self.checkpoint_every and it % self.checkpoint_every == 0
+        # Checkpoints must only ever hold guard-passed state: when guarding
+        # is on, a checkpoint step that falls between probe points is probed
+        # too (guard_every=3, checkpoint_every=2 must not persist a NaN born
+        # at step 2 and first probed at step 3).
+        if do_guard or (do_ckpt and self.guard_every):
+            report = check_fields(*state, names=self.names)
+            if not report.ok:
+                state, it = self._trip(state, it, report)
+                return state, it  # fresh state: skip checkpoint/crash this round
+            if self.policy == "rollback":
+                self._last_good = snapshot_state(state)
+                self._last_good_step = it
+        if do_ckpt:
+            from . import checkpoint as _ckpt
+
+            _ckpt.save_checkpoint(self.checkpoint_dir, state, it)
+        self._injector.maybe_crash(it)
+        return state, it
+
+    def _trip(self, state: tuple, it: int, report: FieldReport) -> tuple:
+        msg = f"NaN/Inf guard tripped at step {it}: {report.summary()}"
+        if self.policy == "raise":
+            raise GuardError(msg, step=it, report=report)
+        if self.policy == "warn":
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+            return state, it
+        # rollback
+        if self._last_good is None:
+            raise GuardError(
+                msg + " — policy='rollback' but no good state was ever "
+                "recorded (is guard_every set?)",
+                step=it,
+                report=report,
+            )
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise GuardError(
+                msg + f" — giving up after {self.max_rollbacks} rollback(s): "
+                "the fault re-occurs deterministically",
+                step=it,
+                report=report,
+            )
+        warnings.warn(
+            msg + f" — rolling back to step {self._last_good_step}",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return snapshot_state(self._last_good), self._last_good_step

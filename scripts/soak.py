@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Fault-injection soak: diffusion3d under every IGG_FAULT_INJECT fault type.
+
+Orchestrates child runs of the flagship model while cycling through the
+fault-injection knobs (docs/robustness.md) and verifies that every fault is
+*recovered* — the final field of each scenario must be bit-identical to the
+fault-free baseline.  Exits nonzero on any unrecovered failure, so it can
+gate a CI lane or soak a new runtime build:
+
+    python scripts/soak.py                 # all scenarios, defaults
+    python scripts/soak.py --steps 24 --scenarios halo_corrupt worker_crash
+
+Scenarios:
+
+* ``baseline``     — no fault; produces the reference field.
+* ``init_flake``   — the first 2 `init_distributed` attempts fail
+  (simulated coordinator race); ``IGG_INIT_RETRIES=3`` must bring the
+  runtime up anyway.
+* ``halo_corrupt`` — a NaN is injected into one block mid-run; the
+  ``guard_every=1`` probe must trip and ``policy=rollback`` must finish
+  the run finite and bit-identical.
+* ``worker_crash`` — the process hard-exits (status 17) right after a
+  checkpoint; the orchestrator restarts it against the same checkpoint
+  directory and the resumed run must complete bit-identical.
+
+Each scenario runs in a fresh child process (a crash must not take the
+orchestrator down, and init faults need a pristine runtime).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+CRASH_STATUS = 17  # FaultInjector.CRASH_STATUS
+SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# child: one guarded diffusion run
+# ---------------------------------------------------------------------------
+
+
+def child_main(args) -> int:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.devices}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    grid_kwargs = {}
+    if args.distributed:
+        # Single-process coordinator bring-up: the init_flake scenario
+        # exercises the real retry path of jax.distributed.initialize.
+        grid_kwargs = dict(
+            init_distributed=True,
+            distributed_kwargs=dict(
+                coordinator_address=f"127.0.0.1:{args.port}",
+                num_processes=1,
+                process_id=0,
+            ),
+        )
+    T = diffusion3d.run(
+        args.steps,
+        args.nx,
+        args.nx,
+        args.nx,
+        quiet=True,
+        guard_every=1,
+        guard_policy="rollback",
+        checkpoint_every=2,
+        checkpoint_dir=args.ckpt_dir,
+        **grid_kwargs,
+    )
+    arr = np.asarray(T)
+    if not np.isfinite(arr).all():
+        print("SOAK CHILD: non-finite final field", file=sys.stderr)
+        return 1
+    np.save(args.out, arr)
+    print("SOAK CHILD OK", flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+class _Timeout:
+    """Stand-in result for a child that outlived --timeout: nonzero rc plus
+    whatever output the child produced, so the scenario reports FAIL with
+    diagnostics instead of crashing the orchestrator."""
+
+    returncode = -1
+
+    def __init__(self, e: subprocess.TimeoutExpired):
+        self.stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        self.stderr = (
+            (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        ) + f"\n[soak] child timed out after {e.timeout}s and was killed"
+
+
+def _run_child(cmd, env, timeout):
+    try:
+        return subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired as e:
+        return _Timeout(e)
+
+
+def _spawn_child(args, scenario: str, workdir: str, env_extra: dict, *, ckpt: str | None = None) -> tuple:
+    import shutil
+
+    out = os.path.join(workdir, f"{scenario}.npy")
+    if ckpt is None:
+        ckpt = os.path.join(workdir, f"ckpt_{scenario}")
+        # A fresh scenario must not auto-resume from a previous soak's
+        # checkpoints (RunGuard.start picks up anything in the dir); the
+        # worker_crash RESTART leg passes its dir explicitly to reuse it.
+        shutil.rmtree(ckpt, ignore_errors=True)
+    env = dict(os.environ)
+    env.pop("IGG_FAULT_INJECT", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p
+    )
+    env.update(env_extra)
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--steps", str(args.steps), "--nx", str(args.nx),
+        "--devices", str(args.devices),
+        "--ckpt-dir", ckpt, "--out", out,
+    ]
+    if env_extra.get("_distributed"):
+        cmd += ["--distributed", "--port", str(_free_port())]
+        env.pop("_distributed")
+    return _run_child(cmd, env, args.timeout), out, ckpt
+
+
+def _report(name: str, ok: bool, detail: str = "") -> bool:
+    print(f"[soak] {name:14s} {'PASS' if ok else 'FAIL'}  {detail}".rstrip())
+    return ok
+
+
+def orchestrate(args) -> int:
+    import numpy as np
+
+    os.makedirs(args.workdir, exist_ok=True)
+    failures = 0
+
+    proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        _report("baseline", False, f"rc={proc.returncode}")
+        return 1
+    baseline = np.load(base_out)
+    _report("baseline", True, f"steps={args.steps} nx={args.nx}")
+
+    for scenario in args.scenarios:
+        if scenario == "init_flake":
+            env = {
+                "IGG_FAULT_INJECT": "init_flake:2",
+                "IGG_INIT_RETRIES": "3",
+                "IGG_INIT_BACKOFF_S": "0.05",
+                "_distributed": "1",
+            }
+            proc, out, _ = _spawn_child(args, scenario, args.workdir, env)
+            ok = proc.returncode == 0 and np.array_equal(
+                np.load(out), baseline
+            )
+            if not _report(scenario, ok, f"rc={proc.returncode}"):
+                print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+                failures += 1
+
+        elif scenario == "halo_corrupt":
+            mid = max(1, args.steps // 2)
+            env = {"IGG_FAULT_INJECT": f"halo_corrupt:step{mid}"}
+            proc, out, _ = _spawn_child(args, scenario, args.workdir, env)
+            ok = (
+                proc.returncode == 0
+                and "rolling back" in (proc.stdout + proc.stderr)
+                and np.array_equal(np.load(out), baseline)
+            )
+            if not _report(
+                scenario, ok, f"rc={proc.returncode} (guard tripped + rollback)"
+            ):
+                print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+                failures += 1
+
+        elif scenario == "worker_crash":
+            mid = max(2, (args.steps // 2) // 2 * 2)  # a checkpointed step
+            env = {"IGG_FAULT_INJECT": f"worker_crash:step{mid}:proc0"}
+            proc, out, ckpt = _spawn_child(args, scenario, args.workdir, env)
+            if proc.returncode != CRASH_STATUS:
+                _report(scenario, False, f"expected crash rc={CRASH_STATUS}, got {proc.returncode}")
+                print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+                failures += 1
+                continue
+            # restart against the same checkpoint dir: must resume + finish
+            proc2, out, _ = _spawn_child(args, scenario, args.workdir, {}, ckpt=ckpt)
+            ok = (
+                proc2.returncode == 0
+                and "resumed from checkpoint" in (proc2.stdout + proc2.stderr)
+                and np.array_equal(np.load(out), baseline)
+            )
+            if not _report(
+                scenario, ok, f"crash rc={proc.returncode} -> restart rc={proc2.returncode}"
+            ):
+                print(proc2.stdout, proc2.stderr, sep="\n", file=sys.stderr)
+                failures += 1
+
+        else:
+            _report(scenario, False, "unknown scenario")
+            failures += 1
+
+    print(f"[soak] {'ALL RECOVERED' if failures == 0 else f'{failures} UNRECOVERED FAILURE(S)'}")
+    return 1 if failures else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--nx", type=int, default=8)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--workdir", default=os.path.join(REPO, ".soak"))
+    ap.add_argument("--scenarios", nargs="+", default=list(SCENARIOS),
+                    choices=list(SCENARIOS))
+    ap.add_argument("--timeout", type=int, default=600)
+    # child-mode flags
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
+    ap.add_argument("--out", help=argparse.SUPPRESS)
+    ap.add_argument("--distributed", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.child:
+        return child_main(args)
+    return orchestrate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,23 +14,34 @@ finalize-shuts-down-the-runtime lifecycle
 (`/root/reference/src/finalize_global_grid.jl:19-23` analogue).
 """
 
-import faulthandler
 import sys
-
-# Watchdog below the parent's 480 s kill: a deadlock (e.g. a collective not
-# entered by all processes) dumps both workers' stacks into the logs the
-# parent shows on failure, instead of dying silently.
-faulthandler.dump_traceback_later(420, exit=True)
 
 pid = int(sys.argv[1])
 nproc = int(sys.argv[2])
 port = sys.argv[3]
 out_path = sys.argv[4]
 
+import faulthandler
+import os
+
+# Pre-import watchdog: jax import / backend plugin probing can itself stall;
+# arm the raw timer BEFORE any heavy import (the library watchdog below
+# replaces this timer once the package is importable).
+faulthandler.dump_traceback_later(270, exit=True)
+
+# Fresh process: stage the virtual-device count before jax import so older
+# JAX versions (no jax_num_cpu_devices config option) honor it too.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:
+    pass
 jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
@@ -39,6 +50,15 @@ import numpy as np
 import implicitglobalgrid_tpu as igg
 from implicitglobalgrid_tpu.models import diffusion3d
 from implicitglobalgrid_tpu.parallel import distributed as dist
+from implicitglobalgrid_tpu.utils.resilience import arm_watchdog
+
+# Watchdog below BOTH the parent's 480 s kill AND the JAX coordination
+# service's 5-minute shutdown barrier: a straggler that misses that barrier
+# is killed by the coordination service with NO stacks, so the watchdog
+# must fire first — a deadlock or stall then dumps both workers' stacks
+# into the logs the parent shows on failure, instead of dying silently.
+# Replaces (and restarts) the raw pre-import timer armed at the top.
+arm_watchdog(270, exit=True)
 
 NX = 8
 NSTEPS = 3

@@ -9,14 +9,27 @@ reference's single-process self-neighbor trick plus real multi-rank runs.
 
 import os
 
+# XLA_FLAGS must be staged before the CPU backend initializes (first device
+# use), which is later than import — so setting it here covers both import
+# orders, including a sitecustomize that already imported jax.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
 import pytest
 
 # The axon sitecustomize may already have imported jax and registered the TPU
-# plugin, so env vars are too late — use jax.config, which works post-import.
+# plugin, so env vars alone are too late for platform/x64 choices — use
+# jax.config, which works post-import.
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # The config option only exists on newer JAX; older ones take the
+    # XLA_FLAGS staged above (read at backend init, after this module runs).
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 jax.config.update("jax_enable_x64", True)  # reference tests are Float64-heavy
 
 
@@ -27,3 +40,31 @@ def _finalize_grid_after_test():
 
     if igg.grid_is_initialized():
         igg.finalize_global_grid()
+
+
+@pytest.fixture
+def fault_injection(monkeypatch):
+    """Arm ``IGG_FAULT_INJECT`` for one test and hand back the injector.
+
+    Usage::
+
+        def test_x(fault_injection):
+            inj = fault_injection("halo_corrupt:step3:block5")
+            ...
+
+    Also wires the injector into `ops.halo`'s post-exchange hook point so
+    direct `update_halo` calls see the fault.  Everything is torn down after
+    the test (env var, injector cache, halo hook).
+    """
+    from implicitglobalgrid_tpu.ops import halo as _halo
+    from implicitglobalgrid_tpu.utils import resilience
+
+    def arm(spec: str):
+        monkeypatch.setenv("IGG_FAULT_INJECT", spec)
+        resilience.reset_fault_injector()
+        resilience.install_halo_fault_hook()
+        return resilience.get_fault_injector()
+
+    yield arm
+    _halo.set_post_exchange_hook(None)
+    resilience.reset_fault_injector()

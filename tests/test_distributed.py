@@ -29,18 +29,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _pair_env():
+    """Clean slate for spawned worker pairs: no inherited TPU plugin
+    registration, repo importable, no conftest side effects (workers
+    configure jax themselves, before first device use), and no leaked
+    fault spec from an outer harness."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("IGG_FAULT_INJECT", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.path.dirname(_here), env.get("PYTHONPATH")) if p
+    )
+    return env
+
+
 @pytest.fixture(scope="module")
 def dist_out_path(tmp_path_factory):
     port = _free_port()
     out = str(tmp_path_factory.mktemp("dist") / "gathered.npy")
-    env = dict(os.environ)
-    # A clean slate for the children: no inherited TPU plugin registration,
-    # repo importable, and no conftest side effects (workers configure jax
-    # themselves, before first device use).
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (os.path.dirname(_here), env.get("PYTHONPATH")) if p
-    )
+    env = _pair_env()
     worker = os.path.join(_here, "_distributed_worker.py")
     logdir = tmp_path_factory.mktemp("dist_logs")
     logs = [open(logdir / f"worker{pid}.log", "w+") for pid in range(2)]
@@ -142,6 +149,120 @@ def test_two_process_hide_communication_matches_single_process(dist_out_path):
     got = np.load(dist_out_path + ".hc.npy")
     assert got.shape == expected.shape
     np.testing.assert_allclose(got, expected, rtol=1e-13, atol=1e-13)
+
+
+@pytest.mark.fault
+def test_worker_crash_restart_from_checkpoint(tmp_path):
+    """Kill one worker mid-run; restart the pair from the last checkpoint.
+
+    The acceptance path of the resilience subsystem end to end, across a
+    REAL process boundary: (1) an uninterrupted 2-process run is the
+    reference; (2) the same run with ``IGG_FAULT_INJECT=
+    worker_crash:step4:proc1`` loses process 1 right after the step-4
+    checkpoint completes (exit status 17; the orphaned process 0 is
+    reaped); (3) a restarted pair resumes from the step-4 checkpoint and
+    finishes.  The resumed run's gathered field must be BIT-identical to
+    the uninterrupted one.
+    """
+    import shutil
+
+    worker = os.path.join(_here, "_resilience_worker.py")
+    env_base = _pair_env()
+
+    def spawn_pair(mode, ckptdir, out, extra_env=None):
+        env = dict(env_base)
+        env.update(extra_env or {})
+        port = _free_port()
+        logdir = tmp_path / f"logs_{mode}"
+        logdir.mkdir(exist_ok=True)
+        logs = [open(logdir / f"worker{pid}.log", "w+") for pid in range(2)]
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, worker, str(pid), "2", str(port),
+                    mode, str(ckptdir), str(out),
+                ],
+                env=env,
+                stdout=logs[pid],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for pid in range(2)
+        ]
+        return procs, logs
+
+    def read_logs(procs, logs):
+        outs = []
+        for p, f in zip(procs, logs):
+            f.flush()
+            f.seek(0)
+            outs.append((p.returncode, f.read()))
+            f.close()
+        return outs
+
+    def finish_pair(procs, logs, what):
+        try:
+            for p in procs:
+                p.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs = read_logs(procs, logs)
+        for pid, (rc, log) in enumerate(outs):
+            assert rc == 0, f"{what} worker {pid} failed (rc={rc}):\n{log}"
+            assert f"WORKER {pid} OK" in log
+        return outs
+
+    # (1) uninterrupted reference run
+    expected_path = tmp_path / "expected.npy"
+    procs, logs = spawn_pair("normal", tmp_path / "ckpt_ref", expected_path)
+    finish_pair(procs, logs, "reference")
+    expected = np.load(expected_path)
+
+    # (2) crash run: worker 1 hard-exits after the step-4 checkpoint
+    crash_dir = tmp_path / "ckpt_crash"
+    procs, logs = spawn_pair(
+        "crash",
+        crash_dir,
+        tmp_path / "never.npy",
+        extra_env={"IGG_FAULT_INJECT": "worker_crash:step4:proc1"},
+    )
+    try:
+        procs[1].wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    # the survivor loses its peer mid-collective; reap it like an
+    # orchestrator would
+    try:
+        procs[0].wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        procs[0].wait()
+    outs = read_logs(procs, logs)
+    assert procs[1].returncode == 17, (
+        f"worker 1 should have crashed with the injected status 17, got "
+        f"{procs[1].returncode}:\n{outs[1][1]}"
+    )
+    assert "WORKER 1 OK" not in outs[1][1]
+    # the crash left a COMPLETE step-4 checkpoint (meta.json written after
+    # the all-process barrier, before the injected exit)
+    from implicitglobalgrid_tpu.utils.checkpoint import latest_checkpoint
+
+    latest = latest_checkpoint(crash_dir)
+    assert latest is not None and latest.endswith("step_00000004"), latest
+
+    # (3) restart the pair against the same checkpoint dir: resumes at the
+    # checkpointed step and must finish bit-identical to the reference
+    got_path = tmp_path / "resumed.npy"
+    procs, logs = spawn_pair("resume", crash_dir, got_path)
+    finish_pair(procs, logs, "resume")
+    got = np.load(got_path)
+    assert got.shape == expected.shape and got.dtype == expected.dtype
+    np.testing.assert_array_equal(got, expected)
+    shutil.rmtree(tmp_path / "ckpt_ref", ignore_errors=True)
 
 
 def test_gather_invalid_root_raises():

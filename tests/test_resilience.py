@@ -1,0 +1,464 @@
+"""Resilience subsystem: guarded init, NaN/Inf guards, checkpoint/restart,
+fault injection (docs/robustness.md).
+
+Single-process coverage on the 8-device virtual mesh; the crash→restart
+path across a REAL process boundary lives in `test_distributed.py`
+(`test_worker_crash_restart_from_checkpoint`).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import diffusion3d
+from implicitglobalgrid_tpu.parallel import distributed as dist
+from implicitglobalgrid_tpu.utils import checkpoint as ckpt
+from implicitglobalgrid_tpu.utils import config as cfg
+from implicitglobalgrid_tpu.utils import resilience as res
+
+NX = 8
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("IGG_"):
+            monkeypatch.delenv(k)
+    res.reset_fault_injector()
+    yield
+    res.reset_fault_injector()
+
+
+# -- backoff / retry ----------------------------------------------------------
+
+
+def test_backoff_schedule_deterministic_under_seeded_jitter():
+    a = res.backoff_schedule(6, base_s=0.5, jitter=0.5, seed=123)
+    b = res.backoff_schedule(6, base_s=0.5, jitter=0.5, seed=123)
+    assert a == b and len(a) == 6
+    c = res.backoff_schedule(6, base_s=0.5, jitter=0.5, seed=124)
+    assert a != c  # the jitter really is seeded, not constant
+    # exponential envelope: delay i in [base*2^i, base*2^i*(1+jitter)], capped
+    for i, d in enumerate(a):
+        lo = min(0.5 * 2**i, 30.0)
+        assert lo <= d <= lo * 1.5
+
+
+def test_backoff_schedule_no_jitter_exact():
+    assert res.backoff_schedule(4, base_s=1.0, jitter=0.0) == [1.0, 2.0, 4.0, 8.0]
+    assert res.backoff_schedule(0, base_s=1.0) == []
+
+
+def test_backoff_schedule_validation():
+    with pytest.raises(ValueError, match="retries"):
+        res.backoff_schedule(-1)
+    with pytest.raises(ValueError, match="base_s"):
+        res.backoff_schedule(2, base_s=0)
+
+
+def test_retry_call_recovers_and_sleeps_the_schedule():
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("coordinator race")
+        return "up"
+
+    out = res.retry_call(
+        flaky,
+        retries=4,
+        base_backoff_s=0.25,
+        jitter=0.5,
+        seed=9,
+        sleep=slept.append,
+        on_retry=lambda *a: None,
+    )
+    assert out == "up" and len(calls) == 3
+    assert slept == res.backoff_schedule(4, base_s=0.25, jitter=0.5, seed=9)[:2]
+
+
+def test_retry_call_exhaustion_names_the_knob():
+    with pytest.raises(RuntimeError, match="IGG_INIT_RETRIES"):
+        res.retry_call(
+            lambda: (_ for _ in ()).throw(OSError("down")),
+            retries=1,
+            base_backoff_s=0.001,
+            sleep=lambda s: None,
+            on_retry=lambda *a: None,
+        )
+
+
+def test_retry_call_overall_deadline():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def fail():
+        t[0] += 10.0  # each attempt burns 10 virtual seconds
+        raise OSError("down")
+
+    with pytest.raises(RuntimeError, match="deadline"):
+        res.retry_call(
+            fail,
+            retries=5,
+            timeout_s=12.0,
+            base_backoff_s=4.0,
+            jitter=0.0,
+            sleep=lambda s: None,
+            clock=clock,
+            on_retry=lambda *a: None,
+        )
+
+
+def test_init_distributed_retries_through_injected_flakes(
+    clean_env, monkeypatch, fault_injection
+):
+    fault_injection("init_flake:2")
+    attempts = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: attempts.append(kw)
+    )
+    try:
+        dist.init_distributed(retries=3, timeout_s=60, backoff_s=0.001)
+        # two injected coordinator races, then the real call went through
+        assert len(attempts) == 1
+        assert dist.owns_runtime()
+    finally:
+        dist._owns_runtime = False
+
+
+def test_init_distributed_env_tier_precedence(
+    clean_env, monkeypatch, fault_injection
+):
+    # env says no retries -> the injected flake is fatal...
+    monkeypatch.setenv("IGG_INIT_RETRIES", "0")
+    monkeypatch.setenv("IGG_INIT_BACKOFF_S", "0.001")
+    fault_injection("init_flake:1")
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
+    with pytest.raises(RuntimeError, match="IGG_INIT_RETRIES"):
+        dist.init_distributed()
+    # ...but an explicit kwarg overrides the env tier (reference precedence).
+    fault_injection("init_flake:1")
+    try:
+        dist.init_distributed(retries=1)
+        assert dist.owns_runtime()
+    finally:
+        dist._owns_runtime = False
+
+
+def test_init_knob_env_validation(clean_env, monkeypatch):
+    monkeypatch.setenv("IGG_INIT_RETRIES", "-3")
+    with pytest.raises(ValueError, match="IGG_INIT_RETRIES.*>= 0"):
+        cfg.init_retries_env()
+    monkeypatch.setenv("IGG_INIT_TIMEOUT_S", "0")
+    with pytest.raises(ValueError, match="IGG_INIT_TIMEOUT_S.*> 0"):
+        cfg.init_timeout_env()
+    monkeypatch.setenv("IGG_INIT_BACKOFF_S", "nope")
+    with pytest.raises(ValueError, match="IGG_INIT_BACKOFF_S.*number"):
+        cfg.init_backoff_env()
+    monkeypatch.setenv("IGG_GUARD_POLICY", "explode")
+    with pytest.raises(ValueError, match="IGG_GUARD_POLICY.*'raise'"):
+        cfg.guard_policy_env()
+    monkeypatch.setenv("IGG_GUARD_EVERY", "-1")
+    with pytest.raises(ValueError, match="IGG_GUARD_EVERY.*>= 0"):
+        cfg.guard_every_env()
+
+
+def test_is_distributed_initialized_degrades_clearly(monkeypatch):
+    # Simulate a JAX upgrade that removed the private module AND the public
+    # introspection: the answer must be a clear RuntimeError, not an
+    # AttributeError from deep inside jax internals.
+    import jax._src.distributed as private
+
+    monkeypatch.delattr(private, "global_state")
+    if hasattr(jax.distributed, "is_initialized"):
+        monkeypatch.delattr(jax.distributed, "is_initialized")
+    with pytest.raises(RuntimeError, match="jax.distributed.is_initialized"):
+        dist.is_distributed_initialized()
+
+
+def test_watchdog_smoke():
+    with res.watchdog(60):
+        pass  # arms and cancels without firing
+    with res.watchdog(None):
+        pass  # disabled path
+
+
+def test_watchdog_nesting_rearms_outer_strictest_wins(monkeypatch):
+    # faulthandler keeps ONE timer: exiting an inner watchdog must re-arm
+    # the enclosing one, and an inner watchdog with a LAXER deadline (the
+    # init_distributed-600s-inside-a-270s-exit-watchdog pattern of
+    # _resilience_worker.py) must not weaken the outer one.
+    import faulthandler
+
+    armed = []
+    monkeypatch.setattr(
+        faulthandler,
+        "dump_traceback_later",
+        lambda t, **kw: armed.append((t, kw.get("exit", False))),
+    )
+    monkeypatch.setattr(
+        faulthandler, "cancel_dump_traceback_later", lambda: armed.append(None)
+    )
+    assert res._watchdog_stack == []
+    with res.watchdog(120, exit=True):
+        assert armed[-1] == (120.0, True)
+        with res.watchdog(600):  # laxer inner: outer's 120/exit must hold
+            assert armed[-1] == (120.0, True) and len(res._watchdog_stack) == 2
+        with res.watchdog(60):  # tighter inner wins, exit flag ORs in
+            assert armed[-1] == (60.0, True)
+        assert armed[-1] == (120.0, True)  # inner exited: outer re-armed
+    assert armed[-1] is None and res._watchdog_stack == []
+    # linear-script arming survives garbage collection (no context object)
+    res.arm_watchdog(90)
+    assert armed[-1] == (90.0, False) and res._watchdog_stack[-1][0] == 90.0
+    res.disarm_watchdog()
+    assert armed[-1] is None and res._watchdog_stack == []
+
+
+def test_checkpoint_step_is_guarded_between_probe_points(
+    clean_env, fault_injection, tmp_path
+):
+    # guard_every=3, checkpoint_every=2, NaN at step 2: the step-2
+    # checkpoint must be probed (and trip) — never persist un-probed state.
+    fault_injection("halo_corrupt:step2")
+    with pytest.raises(igg.GuardError) as ei:
+        diffusion3d.run(
+            6, NX, NX, NX, guard_every=3, guard_policy="raise",
+            checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True,
+        )
+    assert ei.value.step == 2
+    assert igg.latest_checkpoint(tmp_path) is None  # nothing poisoned on disk
+
+
+# -- numerical guards ---------------------------------------------------------
+
+
+def test_check_fields_all_finite():
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.ones((NX, NX, NX))
+    report = igg.check_fields(T, names=("T",))
+    assert report.ok
+    assert "all finite" in report.summary()
+
+
+def test_check_fields_reports_owning_block_coords():
+    igg.init_global_grid(NX, NX, NX, quiet=True)  # dims (2,2,2)
+    T = igg.zeros((NX, NX, NX))
+    C = igg.ones((NX, NX, NX))
+    # poison an interior cell of block (1, 0, 1) = global (8+1, 1, 8+1)
+    T = T.at[(NX + 1, 1, NX + 1)].set(jnp.inf)
+    report = igg.check_fields(T, C, names=("T", "C"))
+    assert not report.ok
+    assert report.bad_blocks == {"T": ((1, 0, 1),)}
+    assert "T: block(s) (1, 0, 1)" in report.summary()
+
+
+def test_check_fields_lower_rank_field_no_phantom_blocks():
+    # A 2-D field on the 3-D mesh is replicated along z: its bad block must
+    # be reported ONCE (coords clamped over the field's own dims), not once
+    # per z-replica.
+    igg.init_global_grid(NX, NX, NX, quiet=True)  # dims (2,2,2)
+    F = igg.zeros((NX, NX))
+    F = F.at[(1, NX + 1)].set(jnp.nan)  # block (0, 1)
+    report = igg.check_fields(F, names=("F",))
+    assert report.bad_blocks == {"F": ((0, 1, 0),)}, report
+
+
+def test_check_fields_integer_fields_always_finite():
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    I = igg.full((NX, NX, NX), 3, jnp.int32)
+    assert igg.check_fields(I).ok
+
+
+def test_guard_trips_at_exact_step_with_block_coords(clean_env, fault_injection):
+    fault_injection("halo_corrupt:step3:block5")
+    with pytest.raises(igg.GuardError) as ei:
+        diffusion3d.run(6, NX, NX, NX, guard_every=1, guard_policy="raise", quiet=True)
+    assert ei.value.step == 3
+    assert "(1, 0, 1)" in str(ei.value)  # rank 5 on dims (2,2,2)
+    assert not igg.grid_is_initialized()  # failed run tore the grid down
+
+
+def test_guard_trips_within_guard_every_steps(clean_env, fault_injection):
+    fault_injection("halo_corrupt:step3")
+    with pytest.raises(igg.GuardError) as ei:
+        diffusion3d.run(8, NX, NX, NX, guard_every=2, guard_policy="raise", quiet=True)
+    assert ei.value.step == 4  # injected at 3, first probe after is step 4
+    assert "(0, 0, 0)" in str(ei.value)  # default target block 0
+
+
+def test_guard_policy_warn_continues(clean_env, fault_injection):
+    fault_injection("halo_corrupt:step2")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        T = diffusion3d.run(
+            4, NX, NX, NX, guard_every=1, guard_policy="warn", quiet=True
+        )
+    assert any("guard tripped at step 2" in str(x.message) for x in w)
+    assert not np.isfinite(np.asarray(T)).all()  # warn lets the NaN spread
+
+
+def test_guard_policy_rollback_completes_finite(clean_env, fault_injection):
+    fault_injection("halo_corrupt:step3:block2")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        T = diffusion3d.run(
+            6, NX, NX, NX, guard_every=1, guard_policy="rollback", quiet=True
+        )
+    assert np.isfinite(np.asarray(T)).all()
+    assert any("rolling back to step 2" in str(x.message) for x in w)
+    # the rolled-back run reproduces the fault-free result bit-exactly
+    # (the injector fires once; the rollback replays from the last good state)
+    res.reset_fault_injector()
+    os.environ.pop("IGG_FAULT_INJECT", None)
+    T_ref = diffusion3d.run(6, NX, NX, NX, quiet=True)
+    np.testing.assert_array_equal(np.asarray(T), np.asarray(T_ref))
+
+
+def test_halo_hook_corruption_tripped_by_direct_check(clean_env, fault_injection):
+    """The ops/halo.py hook point: corruption of a direct update_halo call
+    is visible to check_fields (corruption→guard-trip, no model loop)."""
+    fault_injection("halo_corrupt:step1:block3")
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.ones((NX, NX, NX))
+    T = igg.update_halo(T)
+    report = igg.check_fields(T, names=("T",))
+    assert not report.ok
+    assert report.bad_blocks["T"] == ((0, 1, 1),)  # rank 3 on dims (2,2,2)
+
+
+def test_fault_spec_validation(clean_env):
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        res.FaultInjector.from_spec("cosmic_ray:step1")
+    with pytest.raises(ValueError, match="init_flake:N"):
+        res.FaultInjector.from_spec("init_flake:two")
+    with pytest.raises(ValueError, match="stepN"):
+        res.FaultInjector.from_spec("halo_corrupt:12")
+    with pytest.raises(ValueError, match="block"):
+        res.FaultInjector.from_spec("halo_corrupt:step2:proc1")
+    inj = res.FaultInjector.from_spec("worker_crash:step7:proc1")
+    assert (inj.kind, inj.step, inj.target) == ("worker_crash", 7, 1)
+    assert not res.FaultInjector.from_spec(None).active
+
+
+# -- checkpoint/restart -------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "bfloat16"])
+def test_checkpoint_roundtrip_bit_exact(tmp_path, dtype):
+    import ml_dtypes
+
+    dt = np.dtype({"bfloat16": ml_dtypes.bfloat16}.get(dtype, dtype))
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.zeros((NX, NX, NX), dt)
+    X, Y, Z = igg.coord_fields(T, (0.37, 0.11, 0.53), dtype=dt)
+    state = (X, (Y * 3 + Z).astype(dt))
+    path = igg.save_checkpoint(tmp_path, state, 12, extra={"model": "t"})
+    got, step, extra = igg.restore_checkpoint(path)
+    assert step == 12 and extra == {"model": "t"}
+    for a, b in zip(got, state):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        an, bn = np.asarray(a), np.asarray(b)
+        # bit-exact: compare the raw bytes, not values (covers -0.0 etc.)
+        assert an.tobytes() == bn.tobytes()
+        assert a.sharding.is_equivalent_to(b.sharding, a.ndim)
+
+
+def test_checkpoint_staggered_fields_roundtrip(tmp_path):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    P = igg.ones((NX, NX, NX))
+    Vx = igg.full((NX + 1, NX, NX), 2.5)
+    path = igg.save_checkpoint(tmp_path, (P, Vx), 1)
+    (gP, gVx), step, _ = igg.restore_checkpoint(path, like=(P, Vx))
+    np.testing.assert_array_equal(np.asarray(gVx), np.asarray(Vx))
+
+
+def test_latest_checkpoint_ignores_incomplete(tmp_path):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.ones((NX, NX, NX))
+    p2 = igg.save_checkpoint(tmp_path, (T,), 2)
+    p5 = igg.save_checkpoint(tmp_path, (T,), 5)
+    assert igg.latest_checkpoint(tmp_path) == p5
+    # a crash mid-save leaves no meta.json -> the dir must be ignored
+    os.remove(os.path.join(p5, "meta.json"))
+    assert igg.latest_checkpoint(tmp_path) == p2
+    assert igg.latest_checkpoint(tmp_path / "nowhere") is None
+
+
+def test_checkpoint_prune_keeps_newest(tmp_path):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.ones((NX, NX, NX))
+    for s in (1, 2, 3):
+        igg.save_checkpoint(tmp_path, (T,), s)
+    removed = ckpt.prune_checkpoints(tmp_path, keep=2)
+    assert [os.path.basename(r) for r in removed] == ["step_00000001"]
+    assert igg.latest_checkpoint(tmp_path).endswith("step_00000003")
+
+
+def test_restore_rejects_topology_mismatch(tmp_path):
+    igg.init_global_grid(NX, NX, NX, quiet=True)  # dims (2,2,2)
+    T = igg.ones((NX, NX, NX))
+    path = igg.save_checkpoint(tmp_path, (T,), 3)
+    igg.finalize_global_grid()
+    igg.init_global_grid(NX, NX, NX, dimx=4, dimy=2, dimz=1, quiet=True)
+    with pytest.raises(ValueError, match="different grid topology"):
+        igg.restore_checkpoint(path)
+
+
+def test_restore_rejects_wrong_overlap(tmp_path):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.ones((NX, NX, NX))
+    path = igg.save_checkpoint(tmp_path, (T,), 3)
+    igg.finalize_global_grid()
+    igg.init_global_grid(NX, NX, NX, overlapx=4, overlapy=4, overlapz=4, quiet=True)
+    with pytest.raises(ValueError, match="overlaps"):
+        igg.restore_checkpoint(path)
+
+
+def test_model_checkpoint_resume_bit_identical(tmp_path, clean_env):
+    T_full = diffusion3d.run(6, NX, NX, NX, quiet=True)
+    # partial run with checkpoints, then a fresh run resumes from step 4
+    diffusion3d.run(4, NX, NX, NX, checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True)
+    assert igg.latest_checkpoint(tmp_path).endswith("step_00000004")
+    T_res = diffusion3d.run(6, NX, NX, NX, checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True)
+    np.testing.assert_array_equal(np.asarray(T_res), np.asarray(T_full))
+
+
+# -- env-tier precedence for the run-guard knobs ------------------------------
+
+
+def test_runguard_env_tier_precedence(clean_env, monkeypatch, tmp_path):
+    # defaults: everything off
+    g = res.RunGuard()
+    assert (g.guard_every, g.policy, g.checkpoint_every) == (0, "raise", 0)
+    # env tier
+    monkeypatch.setenv("IGG_GUARD_EVERY", "5")
+    monkeypatch.setenv("IGG_GUARD_POLICY", "rollback")
+    monkeypatch.setenv("IGG_CHECKPOINT_EVERY", "10")
+    monkeypatch.setenv("IGG_CHECKPOINT_DIR", str(tmp_path))
+    g = res.RunGuard()
+    assert (g.guard_every, g.policy, g.checkpoint_every) == (5, "rollback", 10)
+    assert g.checkpoint_dir == str(tmp_path)
+    # kwargs beat env (the reference's precedence)
+    g = res.RunGuard(guard_every=2, policy="warn", checkpoint_every=3,
+                     checkpoint_dir=str(tmp_path / "x"))
+    assert (g.guard_every, g.policy, g.checkpoint_every) == (2, "warn", 3)
+    assert g.checkpoint_dir == str(tmp_path / "x")
+
+
+def test_runguard_validation(clean_env):
+    with pytest.raises(ValueError, match="policy"):
+        res.RunGuard(policy="explode")
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        res.RunGuard(checkpoint_every=2)
+    with pytest.raises(ValueError, match="guard_every"):
+        res.RunGuard(guard_every=-1)
