@@ -80,10 +80,13 @@ def main():
         # the model's fallback uses, evaluated on the actual local block);
         # per-rep spread rides along (VERDICT r3 #7: cross-round drift on a
         # time-shared chip is uninterpretable without it).
-        return {
+        rec = {
             "teff": r["value"], "t_it_ms": r["t_it_ms"], "path": r.get("path"),
             "spread": r.get("spread"),
         }
+        if "pipelined" in r:
+            rec["pipelined"] = r["pipelined"]
+        return rec
 
     def _fused():
         r = _bench.bench_diffusion(
@@ -229,6 +232,56 @@ def main():
     _extra("acoustic_periodz_pallas_fused6", _acoustic_periodz_fused)
     _extra("porous_periodz_pallas_fused6", _porous_periodz_fused)
 
+    # --- ISSUE 2: pipelined-vs-serialized group-schedule A/B ---------------
+    # One paired record per model on its periodic-z fused config.  On this
+    # 1-chip grid only z communicates, so the ring/mid split is
+    # inadmissible there and the "pipelined" run honestly records its
+    # fallback-serialized provenance; the periodic-xz sibling (x
+    # self-neighbor => the split ENGAGES on one chip) measures the actual
+    # split-launch cadence, and the 256-chip AOT proxy below shows the
+    # interior passes scheduled across the collectives structurally.
+    def _ab(fn):
+        return {"serialized": fn(False), "pipelined": fn(True)}
+
+    def _diffusion_ab(period):
+        def run(p):
+            r = _bench.bench_diffusion(
+                n=256, chunk=24, reps=3, dtype="float32", emit=False,
+                fused_k=4, overlap=8, period=period, pipelined=p,
+            )
+            return _fused_record(r)
+
+        return _ab(run)
+
+    def _acoustic_ab(period):
+        def run(p):
+            r = _bench.bench_acoustic(
+                n=256, chunk=24, reps=3, dtype="float32", emit=False,
+                fused_k=6, overlap=12, period=period, pipelined=p,
+            )
+            return _fused_record(r)
+
+        return _ab(run)
+
+    def _porous_ab(period):
+        def run(p):
+            r = _bench.bench_porous(
+                n=256, chunk=2, reps=3, npt=12, dtype="float32", emit=False,
+                fused_k=6, overlap=14, period=period, pipelined=p,
+            )
+            rec = _fused_record(r)
+            rec["t_pt_ms"] = r.get("t_pt_ms")
+            return rec
+
+        return _ab(run)
+
+    _extra("diffusion_periodz_pipelined_ab", lambda: _diffusion_ab("z"))
+    _extra("acoustic_periodz_pipelined_ab", lambda: _acoustic_ab("z"))
+    _extra("porous_periodz_pipelined_ab", lambda: _porous_ab("z"))
+    _extra("diffusion_periodxz_pipelined_ab", lambda: _diffusion_ab("xz"))
+    _extra("acoustic_periodxz_pipelined_ab", lambda: _acoustic_ab("xz"))
+    _extra("porous_periodxz_pipelined_ab", lambda: _porous_ab("xz"))
+
     def _weak_codepath():
         # VERDICT r4 missing #2(a): the virtual-mesh weak-scaling CODE-PATH
         # record, in the driver artifact itself.  Subprocess: the TPU
@@ -273,11 +326,23 @@ def main():
         # VERDICT r4 missing #2(b): the north-star-topology structural
         # record — 256-chip (4,4,16) mesh, 512^3/chip, packed-z exchange;
         # per-hop collective-permute payload bytes from the compiled HLO.
-        # The written efficiency budget lives in docs/performance.md.
-        return _bench.aot_weak_proxy(emit=False)
+        # pipelined=False: the serialized differential control for the
+        # pipelined proxy below (same program as before the knob existed,
+        # plus its overlap-evidence fields).  The written efficiency budget
+        # lives in docs/performance.md.
+        return _bench.aot_weak_proxy(emit=False, pipelined=False)
+
+    def _weak_aot_proxy_pipelined():
+        # ISSUE 2 acceptance (CPU-only environments): the pipelined cadence
+        # at the north-star topology — the HLO must show interior kernel
+        # launches schedulable across the group-boundary collective-permutes
+        # (overlap_evidence.independent_pairs > 0) with per-hop payloads
+        # unchanged vs the serialized control.
+        return _bench.aot_weak_proxy(emit=False, pipelined=True)
 
     _extra("weak_scaling_codepath", _weak_codepath)
     _extra("weak_scaling_aot_proxy_256chip", _weak_aot_proxy)
+    _extra("weak_scaling_aot_proxy_256chip_pipelined", _weak_aot_proxy_pipelined)
     best = rec["value"]
     extras["headline_path"] = "xla"
     fused = extras.get("diffusion_pallas_fused4", {})

@@ -138,6 +138,34 @@ def _time_steps(step, state, chunk: int, reps: int):
     return t_it, state, spread
 
 
+def _pipelined_provenance(pipelined, fused_k, model_mod, local_shape, itemsize,
+                          fused_tile, support_kwargs=None):
+    """(metric suffix, extra record) for a ``pipelined`` request.
+
+    Same deterministic-provenance contract as `_fused_provenance`: the
+    admissibility check is the model's own (`pipelined_support_error`), so
+    a config whose split fell back to the serialized schedule is recorded
+    as such instead of labeling a serialized number "pipelined" — and the
+    AUTO default's decision is recorded too (``auto-on``/``auto-off``),
+    since auto engages the pipelined schedule whenever admissible and an
+    unmarked metric would make cross-round drift uninterpretable.  The
+    metric-name suffix changes only for an explicit ``pipelined=True``
+    (auto keeps prior rounds' names comparable)."""
+    if not fused_k:
+        return "", None
+    bx, by = fused_tile if fused_tile is not None else (None, None)
+    err = model_mod.pipelined_support_error(
+        tuple(local_shape), fused_k, itemsize, bx, by, **(support_kwargs or {})
+    )
+    if pipelined is None:
+        return "", {"pipelined": "auto-on" if err is None else f"auto-off: {err}"}
+    if not pipelined:
+        return "", {"pipelined": "off"}
+    if err is None:
+        return "_piped", {"pipelined": "on"}
+    return "", {"pipelined": f"fallback: {err}"}
+
+
 def _fused_provenance(fused_k, support_error, local_shape, itemsize, fused_tile,
                       z_active=False):
     """Metric suffix + path record for a ``fused_k`` request.
@@ -192,7 +220,8 @@ def _emit(name, teff, t_it, extra=None, emit=True):
 
 def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
                     devices=None, emit=True, fused_k=None, fused_tile=None,
-                    exchange_every=1, overlap=None, force_spmd=False, period=None):
+                    exchange_every=1, overlap=None, force_spmd=False, period=None,
+                    pipelined=None):
     """Benchmarks run with ``donate=False``: buffer donation costs ~3x on the
     tunneled single-chip backend used for the round measurements (measured:
     375 -> 119 GB/s at 256^3 f32; identical HLO, runtime-side penalty), and
@@ -215,7 +244,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
     )
     step = diffusion3d.make_multi_step(
         params, chunk, donate=False, fused_k=fused_k, fused_tile=fused_tile,
-        exchange_every=exchange_every,
+        exchange_every=exchange_every, pipelined=pipelined,
     )
     from implicitglobalgrid_tpu.ops.pallas_stencil import fused_support_error
 
@@ -226,6 +255,10 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
         jax.numpy.dtype(dtype).itemsize, fused_tile,
         z_active=dim_has_halo_activity(igg.get_global_grid(), 2),
     )
+    psuf, prec = _pipelined_provenance(
+        pipelined, fused_k, diffusion3d, igg.local_shape(state[0]),
+        jax.numpy.dtype(dtype).itemsize, fused_tile,
+    )
     t_it, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
@@ -233,11 +266,14 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
     extra = {"dims": list(gg.dims), "nprocs": gg.nprocs, "spread": spread}
     if fpath:
         extra["path"] = fpath
+    if prec:
+        extra.update(prec)
     return _emit(
         f"diffusion3d_{n}_{dtype}"
         + (f"_period{period}" if period else "")
         + ("_overlap" if hide_comm else "")
         + fsuf
+        + psuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
@@ -248,7 +284,7 @@ def bench_diffusion(n=256, chunk=25, reps=4, dtype="float32", hide_comm=False,
 
 def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, devices=None,
                    emit=True, exchange_every=1, overlap=None, fused_k=None,
-                   fused_tile=None, period=None):
+                   fused_tile=None, period=None, pipelined=None):
     """``fused_k``: the temporally-blocked staggered Pallas kernel
     (`ops/pallas_leapfrog.py`, k leapfrog steps per HBM pass) — needs
     ``n % 128 == 0`` in the minor dimension (use ``--n 256``)."""
@@ -266,7 +302,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
     )
     step = acoustic3d.make_multi_step(
         params, chunk, donate=False, exchange_every=exchange_every,
-        fused_k=fused_k, fused_tile=fused_tile,
+        fused_k=fused_k, fused_tile=fused_tile, pipelined=pipelined,
     )
     from implicitglobalgrid_tpu.ops.pallas_leapfrog import fused_support_error
 
@@ -277,6 +313,10 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
         jax.numpy.dtype(dtype).itemsize, fused_tile,
         z_active=dim_has_halo_activity(igg.get_global_grid(), 2),
     )
+    psuf, prec = _pipelined_provenance(
+        pipelined, fused_k, acoustic3d, igg.local_shape(state[0]),
+        jax.numpy.dtype(dtype).itemsize, fused_tile,
+    )
     t_it, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
     igg.finalize_global_grid()
@@ -284,11 +324,14 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
     extra = {"dims": list(gg.dims), "nprocs": gg.nprocs, "spread": spread}
     if fpath:
         extra["path"] = fpath
+    if prec:
+        extra.update(prec)
     return _emit(
         f"acoustic3d_{n}_{dtype}"
         + (f"_period{period}" if period else "")
         + ("_overlap" if hide_comm else "")
         + fsuf
+        + psuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_it / 1e9,
         t_it,
@@ -299,7 +342,7 @@ def bench_acoustic(n=192, chunk=25, reps=4, dtype="float32", hide_comm=False, de
 
 def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
                  emit=True, exchange_every=1, overlap=None, fused_k=None,
-                 fused_tile=None, period=None):
+                 fused_tile=None, period=None, pipelined=None):
     """``chunk`` whole time steps (= ``chunk*npt`` PT iterations) per call via
     `porous_convection3d.make_multi_step` — one XLA program, like the other
     models' production paths.  ``fused_k``: the temporally-blocked PT kernel
@@ -318,7 +361,7 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     )
     step = pc.make_multi_step(
         params, chunk, donate=False, exchange_every=exchange_every,
-        fused_k=fused_k, fused_tile=fused_tile,
+        fused_k=fused_k, fused_tile=fused_tile, pipelined=pipelined,
     )
     from implicitglobalgrid_tpu.ops.pallas_pt import fused_support_error
 
@@ -328,6 +371,11 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
         fused_k, fused_support_error, igg.local_shape(state[0]),
         jax.numpy.dtype(dtype).itemsize, fused_tile,
         z_active=dim_has_halo_activity(igg.get_global_grid(), 2),
+    )
+    psuf, prec = _pipelined_provenance(
+        pipelined, fused_k, pc, igg.local_shape(state[0]),
+        jax.numpy.dtype(dtype).itemsize, fused_tile,
+        support_kwargs={"npt": npt},
     )
     t_step, state, spread = _time_steps(step, state, chunk, reps)
     gg = igg.get_global_grid()
@@ -339,10 +387,13 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
              "t_pt_ms": round(t_pt * 1e3, 4), "spread": spread}
     if fpath:
         extra["path"] = fpath
+    if prec:
+        extra.update(prec)
     return _emit(
         f"porous_convection3d_{n}_{dtype}_npt{npt}"
         + (f"_period{period}" if period else "")
         + fsuf
+        + psuf
         + (f"_xch{exchange_every}" if exchange_every > 1 else ""),
         nbytes / t_pt / 1e9,
         t_step,
@@ -351,7 +402,7 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     )
 
 
-def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
+def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True, pipelined=None):
     """North-star-topology AOT compile proxy (VERDICT r4 missing #2).
 
     Compile the production fused z-patch cadence for a 256-chip
@@ -365,6 +416,13 @@ def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
     Uses the shared synthetic-GlobalGrid AOT scaffold
     (`implicitglobalgrid_tpu.utils.aot`), like scripts/verify_tpu.py's
     checks 9-11.
+
+    ``pipelined``: forward the cadence knob and additionally report
+    `pipelined_overlap_evidence` — the count of (collective, kernel
+    launch) pairs the optimized HLO leaves mutually independent, i.e. the
+    interior passes XLA may schedule across the in-flight
+    `collective-permute`s.  A serialized compile of the same config is the
+    differential control (`bench.py` records both).
     """
     import math as _math
 
@@ -372,7 +430,10 @@ def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from implicitglobalgrid_tpu.utils.aot import synthetic_topology_grid
-    from implicitglobalgrid_tpu.utils.hlo_analysis import collective_payloads
+    from implicitglobalgrid_tpu.utils.hlo_analysis import (
+        collective_payloads,
+        pipelined_overlap_evidence,
+    )
 
     nchips = _math.prod(dims)
     o = 2 * k
@@ -383,7 +444,9 @@ def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
             dx=0.1, dy=0.1, dz=0.1, dt=0.1 * 0.1 / 8.1,
             dtype=jax.numpy.float32,
         )
-        step = diffusion3d.make_multi_step(params, k, donate=False, fused_k=k)
+        step = diffusion3d.make_multi_step(
+            params, k, donate=False, fused_k=k, pipelined=pipelined
+        )
         shapes = tuple(
             jax.ShapeDtypeStruct(
                 tuple(dims[d] * nloc for d in range(3)),
@@ -394,6 +457,7 @@ def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
         )
         fn = step._build(gg, shapes, jax.tree.flatten(shapes)[1])
         txt = fn.lower(*shapes).compile().as_text()
+        psel = diffusion3d.pipelined_support_error((nloc,) * 3, k, 4, gg=gg)
 
     hops = collective_payloads(txt)
     by_shape: dict = {}
@@ -402,7 +466,8 @@ def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
         r["count"] += 1
     total = sum(h["bytes"] for h in hops)
     rec = {
-        "metric": f"aot_weak_proxy_{nchips}chip_{nloc}cube",
+        "metric": f"aot_weak_proxy_{nchips}chip_{nloc}cube"
+        + ("_piped" if pipelined and psel is None else ""),
         "dims": list(dims),
         "n_collective_permutes": len(hops),
         "per_hop": by_shape,
@@ -412,6 +477,12 @@ def aot_weak_proxy(dims=(4, 4, 16), nloc=512, k=4, emit=True):
             "NOT a timing; see docs/performance.md's weak-scaling budget"
         ),
     }
+    if pipelined is not None:
+        rec["pipelined"] = (
+            "on" if (pipelined and psel is None)
+            else ("off" if not pipelined else f"fallback: {psel}")
+        )
+        rec["overlap_evidence"] = pipelined_overlap_evidence(txt)
     if emit:
         print(json.dumps(rec), flush=True)
     return rec
@@ -494,6 +565,11 @@ def main():
     p.add_argument("--period", default=None,
                    help="periodic dimensions, e.g. 'z' or 'xz' (the 1-chip "
                         "self-neighbor configs that exercise real exchanges)")
+    p.add_argument("--pipelined", action="store_true", default=None,
+                   help="boundary-first pipelined group schedule (fused_k "
+                        "cadences); omit for the models' auto default")
+    p.add_argument("--serialized", dest="pipelined", action="store_false",
+                   help="force the serialized group schedule")
     p.add_argument("--weak-model", default="diffusion",
                    choices=["diffusion", "porous"],
                    help="model for the weak-scaling config (BASELINE config 4 "
@@ -503,11 +579,12 @@ def main():
     if a.what in ("diffusion", "all"):
         bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k,
                         exchange_every=a.exchange_every, overlap=a.overlap,
-                        period=a.period, **kw)
+                        period=a.period, pipelined=a.pipelined, **kw)
     if a.what in ("acoustic", "all"):
         bench_acoustic(n=a.n or (256 if a.fused_k else 192), hide_comm=a.hide_comm,
                        fused_k=a.fused_k, exchange_every=a.exchange_every,
-                       overlap=a.overlap, period=a.period, **kw)
+                       overlap=a.overlap, period=a.period, pipelined=a.pipelined,
+                       **kw)
     if a.what in ("porous", "all"):
         # porous steps contain npt inner iterations, so the outer chunk stays
         # small unless the user asked for porous explicitly
@@ -518,7 +595,7 @@ def main():
         bench_porous(n=a.n or (256 if a.fused_k else 128), chunk=porous_chunk,
                      reps=a.reps, npt=npt, dtype=a.dtype, fused_k=a.fused_k,
                      exchange_every=a.exchange_every, overlap=a.overlap,
-                     period=a.period)
+                     period=a.period, pipelined=a.pipelined)
     if a.what in ("weak", "all"):
         bench_weak_scaling(n=a.n or 128, chunk=a.chunk, reps=a.reps,
                            dtype=a.dtype, hide_comm=a.hide_comm,

@@ -58,6 +58,160 @@ def run_group_schedule(chunks, body, carry, *, unroll_limit=8,
     return carry
 
 
+def run_pipelined_group_schedule(chunks, boundary, interior, carry, *,
+                                 unroll_limit=8, fori_excess_only=True):
+    """Boundary-first pipelined sibling of `run_group_schedule`.
+
+    Each group's kernel launch is split in two (the `@hide_communication`
+    scheduling of the per-step path — `ops/overlap.py` — lifted to tile
+    granularity inside the fused group schedule):
+
+    * ``boundary(ki, carry) -> (b_out, pend)`` runs the RING tiles — the
+      tiles owning every x/y slab-exchange send plane — and dispatches the
+      group's exchange early (`ops.halo.begin_slab_exchange`): the
+      `collective-permute`s depend only on thin slices of the ring outputs.
+    * ``interior(ki, carry, b_out, pend) -> carry`` runs the MID tiles as
+      an op independent of the in-flight collectives (the ring outputs ride
+      an input/output alias into the interior launch, so XLA schedules the
+      permutes across it), then applies the received slabs
+      (`ops.halo.finish_slab_exchange`) and the group's z-patch carry.
+
+    The split-launch carry threaded through each group keeps per-group
+    results bit-identical to the serialized schedule: ring+mid partition
+    the same tiles tile-for-tile, and the early exchange moves exactly the
+    slabs the serialized exchange would (corner strips patched in,
+    `ops.halo._patch_slab`).  The loop shaping (unrolled prefix, fori
+    excess) is `run_group_schedule`'s.
+    """
+
+    def group(ki, c):
+        b_out, pend = boundary(ki, c)
+        return interior(ki, c, b_out, pend)
+
+    return run_group_schedule(
+        chunks, group, carry,
+        unroll_limit=unroll_limit, fori_excess_only=fori_excess_only,
+    )
+
+
+def resolve_pipelined(pipelined, split_err, shape, k, model: str) -> bool:
+    """Resolve a cadence's ``pipelined`` knob against split admissibility.
+
+    ``pipelined`` is the user knob (None = auto); ``split_err`` is
+    `ops.overlap.tile_split_error`'s verdict (None = admissible) for the
+    traced local block.  Auto turns the pipelined schedule ON whenever the
+    split is admissible (it is bit-identical to the serialized schedule,
+    so the only reason to stay serialized is an inadmissible split);
+    ``pipelined=True`` on an inadmissible config warns once and runs the
+    serialized schedule — the same warn-once fallback contract as the
+    kernel envelope (`warn_fused_fallback`).
+    """
+    if pipelined is False:
+        return False
+    if split_err is None:
+        return True
+    if pipelined:
+        warn_pipelined_fallback(shape, k, split_err, model)
+    return False
+
+
+def split_selector(kernel_mod, shape, k, width, itemsize, bx, by, active01,
+                   zpatch, stagger: int = 0, gg=None):
+    """(selector suffix, admissibility error, resolved tile) for a cadence.
+
+    THE one trace-time gate behind every model's pipelined path (and the
+    benchmark-provenance wrappers): resolves a missing/half tile through
+    the kernel's own ladder (mirroring the kernels' ``bx is None or by is
+    None`` handling), derives the y-halo H for the resolved tile, and
+    checks `ops.overlap.tile_split_error` with the per-field maximum
+    overlaps (``stagger=1`` for the staggered models, whose face fields'
+    shape-aware ``ol`` is one deeper than the grid overlap).  The
+    RESOLVED tile is returned so ragged schedules can pin every chunk's
+    launch to the geometry this gate actually validated (a shorter chunk
+    re-resolving its own ladder default could otherwise launch an
+    unvalidated — or subset-incapable — tile; the validated tile stays
+    legal for any ``ki <= k``: smaller halo, same divisibility).
+    """
+    from ..ops._fused_envelope import aligned_halo
+    from ..ops.overlap import tile_split_error, tile_split_sel
+    from ..parallel.grid import global_grid
+
+    if gg is None:
+        gg = global_grid()
+    shape = tuple(shape)
+    if bx is None or by is None:
+        t = kernel_mod.default_tile(shape, k, itemsize, zpatch=zpatch)
+        if t is None:
+            return None, "no valid kernel tile for this shape", None
+        bx, by = t
+    H = 0 if by == shape[1] else aligned_halo(k)
+    err = tile_split_error(
+        shape, k, width, bx, by, H, active01,
+        ox=gg.overlaps[0] + stagger, oy=gg.overlaps[1] + stagger,
+    )
+    return tile_split_sel(active01), err, (bx, by)
+
+
+def pipelined_support_error(kernel_mod, shape, k, itemsize: int = 4,
+                            bx=None, by=None, gg=None,
+                            stagger: int = 0) -> str | None:
+    """Why the pipelined group schedule cannot split this config, or None.
+
+    Mirrors the cadence builders' trace-time decision: on z-active grids
+    the z-patch kernel variant must be admissible (the pipelined schedule
+    routes z through the in-kernel patches; a z-DUS cadence stays
+    serialized), then the split must clear `split_selector`.  One
+    implementation for the three models (``kernel_mod`` = the model's
+    Pallas kernel module); the per-model wrappers
+    (`models.*.pipelined_support_error`) exist for benchmark provenance.
+    """
+    from ..ops.halo import dim_has_halo_activity
+    from ..parallel.grid import global_grid
+
+    if gg is None:
+        gg = global_grid()
+    shape = tuple(shape)
+    active = tuple(d for d in (0, 1) if dim_has_halo_activity(gg, d))
+    z_active = dim_has_halo_activity(gg, 2)
+    zp = z_active and kernel_mod.fused_support_error(
+        shape, k, itemsize, bx, by, zpatch=True
+    ) is None
+    if z_active and not zp:
+        return "z-active grid without the z-patch kernel: serialized z-DUS cadence"
+    if not zp:
+        # The split only exists on a kernel path: a config the plain
+        # envelope rejects runs the XLA cadence, and labeling it
+        # "pipelined" would corrupt the A/B provenance.
+        kerr = kernel_mod.fused_support_error(shape, k, itemsize, bx, by)
+        if kerr is not None:
+            return f"kernel envelope rejects this config ({kerr}): XLA cadence"
+    _, err, _ = split_selector(
+        kernel_mod, shape, k, k, itemsize, bx, by, active, zp, stagger, gg
+    )
+    return err
+
+
+def warn_pipelined_fallback(shape, k, reason, model: str = "diffusion") -> None:
+    """Warn once per (model, shape, k, reason) that pipelined=True fell back
+    to the serialized group schedule."""
+    import warnings
+
+    key = ("pipelined", model, shape, k, reason)
+    if key in _warned:
+        return
+    _warned.add(key)
+    where = (
+        f"{model}'s local block shape {shape}" if shape is not None
+        else f"the {model} cadence"  # grid-level rejection, no shape to cite
+    )
+    warnings.warn(
+        f"pipelined=True is not admissible for {where} at k={k} ({reason}); "
+        "running the serialized group schedule.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def fused_with_xla_grad(fused_body, xla_body):
     """Make a fused Pallas chunk differentiable via its XLA-cadence twin.
 

@@ -182,9 +182,22 @@ def make_step(params: Params, *, donate: bool = True):
     return stencil(block_step, donate_argnums=donate_argnums)
 
 
+def pipelined_support_error(shape, k, itemsize: int = 4, bx=None, by=None,
+                            gg=None) -> str | None:
+    """Why the pipelined group schedule cannot split this config, or None
+    (benchmark provenance; see `models._fused.pipelined_support_error`)."""
+    from ..ops import pallas_leapfrog
+    from ._fused import pipelined_support_error as _generic
+
+    # stagger=1: the face fields' shape-aware ol is one deeper than the
+    # grid overlap, and their send planes must fit the ring tiles too.
+    return _generic(pallas_leapfrog, shape, k, itemsize, bx, by, gg, stagger=1)
+
+
 def make_multi_step(
     params: Params, nsteps: int, *, donate: bool = True, exchange_every: int = 1,
     fused_k: int | None = None, fused_tile: tuple[int, int] | None = None,
+    pipelined: bool | None = None,
 ):
     """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`).
 
@@ -210,6 +223,12 @@ def make_multi_step(
     blocks the kernel envelope rejects warn once and run the XLA path at the
     same cadence (`fused_support_error` is the single source of truth).
     Requires ``nsteps % fused_k == 0``.
+
+    ``pipelined`` (default auto): boundary-first pipelined group schedule —
+    ring/interior split launches with the all-field slab exchange
+    dispatched off the ring pass, exactly as on
+    `models.diffusion3d.make_multi_step` (bit-identical to the serialized
+    schedule; auto when admissible, see `pipelined_support_error`).
     """
     from jax import lax
 
@@ -266,15 +285,33 @@ def make_multi_step(
             return p_update(P, Vx, Vy, Vz), Vx, Vy, Vz
 
         z_active = dim_has_halo_activity(gg, 2)
-        from ._fused import fused_with_xla_grad, run_group_schedule
+        from ._fused import (
+            fused_with_xla_grad,
+            resolve_pipelined,
+            run_group_schedule,
+            split_selector,
+        )
 
         groups = [fused_k] * (nsteps // fused_k)
+        active01 = tuple(d for d in (0, 1) if d in active)
+
+        def _split(shape, itemsize, zpatch):
+            """(ring/mid selector suffix, admissibility error) for the
+            resolved tile — the shared trace-time gate (`split_selector`;
+            stagger=1: the face fields' ol is one deeper)."""
+            from ..ops import pallas_leapfrog
+
+            return split_selector(
+                pallas_leapfrog, shape, fused_k, fused_k, itemsize, bx, by,
+                active01, zpatch, stagger=1, gg=gg,
+            )
 
         def fused_or_fallback(P, Vx, Vy, Vz, fused_body, xla_body,
-                              zpatch_body=None):
+                              zpatch_body=None, pipelined_bodies=None):
             # Kernel paths wrapped with `fused_with_xla_grad`: primal runs
             # the Pallas chunk, jax.grad differentiates the XLA cadence.
             shape = tuple(P.shape)
+            pb = pipelined_bodies or {}
             if (
                 zpatch_body is not None
                 and z_active
@@ -285,14 +322,35 @@ def make_multi_step(
                 # The in-kernel z-slab application: avoids the whole-array
                 # relayouts a z-dim DUS costs at the kernel boundary (the
                 # exchanged-dimension anisotropy, docs/performance.md).
-                return fused_with_xla_grad(zpatch_body, xla_body)(P, Vx, Vy, Vz)
+                body = zpatch_body
+                if "zpatch" in pb and resolve_pipelined(
+                    pipelined, _split(shape, P.dtype.itemsize, True)[1],
+                    shape, fused_k, "acoustic",
+                ):
+                    body = pb["zpatch"]
+                return fused_with_xla_grad(body, xla_body)(P, Vx, Vy, Vz)
             err = fused_support_error(shape, fused_k, P.dtype.itemsize, bx, by)
             if err is None:
-                return fused_with_xla_grad(fused_body, xla_body)(P, Vx, Vy, Vz)
+                body = fused_body
+                if "plain" in pb and not z_active and resolve_pipelined(
+                    pipelined, _split(shape, P.dtype.itemsize, False)[1],
+                    shape, fused_k, "acoustic",
+                ):
+                    body = pb["plain"]
+                return fused_with_xla_grad(body, xla_body)(P, Vx, Vy, Vz)
             warn_fused_fallback(shape, fused_k, err, model="acoustic")
+            if pipelined and "xla" in pb:
+                return pb["xla"](P, Vx, Vy, Vz)
             return xla_body(P, Vx, Vy, Vz)
 
         if not active:
+            if pipelined:
+                from ._fused import warn_pipelined_fallback
+
+                warn_pipelined_fallback(
+                    None, fused_k,
+                    "no halo activity: nothing to overlap", model="acoustic",
+                )
 
             def fused_chunk(P, Vx, Vy, Vz):
                 # Pad once per chunk; the kernel keeps the padded layout
@@ -372,6 +430,94 @@ def make_multi_step(
             P, Vxp, Vyp, Vzp = apply_z_patches(*s, patches, width=fused_k)
             return (P, *unpad_faces(Vxp, Vyp, Vzp))
 
+        def fused_pipelined_block_step(P, Vx, Vy, Vz):
+            # Boundary-first split of `fused_block_step` (z-inactive):
+            # ring pass feeds the all-field slab exchange early, interior
+            # pass runs across the in-flight collectives.
+            from ..ops.halo import (
+                _padded_logicals,
+                begin_slab_exchange,
+                finish_slab_exchange,
+            )
+            from ._fused import run_pipelined_group_schedule
+
+            sel, _, _ = _split(tuple(P.shape), P.dtype.itemsize, False)
+            s0 = (P, *pad_faces(Vx, Vy, Vz))
+            logicals = _padded_logicals(*s0)
+
+            def boundary(ki, s):
+                out_b = kernel_steps(*s, tile_sel="ring" + sel)
+                pend = begin_slab_exchange(
+                    out_b, (0, 1), width=fused_k, logicals=logicals
+                )
+                return out_b, pend
+
+            def interior(ki, s, out_b, pend):
+                out = kernel_steps(*s, tile_sel="mid" + sel, carry_in=out_b)
+                return finish_slab_exchange(out, pend, logicals=logicals)
+
+            P, Vxp, Vyp, Vzp = run_pipelined_group_schedule(
+                groups, boundary, interior, s0
+            )
+            return (P, *unpad_faces(Vxp, Vyp, Vzp))
+
+        def fused_zpatch_pipelined_step(P, Vx, Vy, Vz):
+            # Boundary-first split of `fused_zpatch_step`: the four fields'
+            # x/y slabs exchange early off the ring pass; the packed z
+            # exports (which every tile feeds) complete with the interior
+            # pass, and their thin communication stays on the group's
+            # serialized tail.
+            from ..ops.halo import (
+                _padded_logicals,
+                apply_z_patches,
+                begin_slab_exchange,
+                finish_slab_exchange,
+                fix_topface_z_exports,
+                identity_z_patches,
+                ol,
+                z_patches_from_exports,
+            )
+            from ._fused import run_pipelined_group_schedule
+
+            s0 = (P, *pad_faces(Vx, Vy, Vz))
+            o_z = ol(2, shape=tuple(P.shape), gg=gg)
+            patches0 = identity_z_patches(*s0, width=fused_k)
+            sel, _, _ = _split(tuple(P.shape), P.dtype.itemsize, True)
+            logicals = _padded_logicals(*s0)
+
+            def boundary(ki, carry):
+                s, patches = carry
+                out_b = kernel_steps(
+                    *s, z_patches=patches, z_export=True, z_overlap=o_z,
+                    tile_sel="ring" + sel,
+                )
+                pend = begin_slab_exchange(
+                    out_b[:4], (0, 1), width=fused_k, logicals=logicals
+                )
+                return out_b, pend
+
+            def interior(ki, carry, out_b, pend):
+                s, patches = carry
+                out = kernel_steps(
+                    *s, z_patches=patches, z_export=True, z_overlap=o_z,
+                    tile_sel="mid" + sel, carry_in=out_b,
+                )
+                s2, exports = out[:4], out[4:]
+                # Top-face fix-up reads the PRE-exchange outputs, exactly
+                # like the serialized cadence's ordering.
+                exports = fix_topface_z_exports(exports, *s2, width=fused_k)
+                s2 = finish_slab_exchange(s2, pend, logicals=logicals)
+                patches2 = z_patches_from_exports(
+                    exports, tuple(s2[0].shape), width=fused_k
+                )
+                return s2, patches2
+
+            s, patches = run_pipelined_group_schedule(
+                groups, boundary, interior, (s0, patches0)
+            )
+            P, Vxp, Vyp, Vzp = apply_z_patches(*s, patches, width=fused_k)
+            return (P, *unpad_faces(Vxp, Vyp, Vzp))
+
         def xla_cadence_step(P, Vx, Vy, Vz):
             def group(i, s):
                 s = lax.fori_loop(0, fused_k, lambda j, s: xla_step(s), s)
@@ -379,9 +525,27 @@ def make_multi_step(
 
             return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
 
+        def xla_pipelined_cadence_step(P, Vx, Vy, Vz):
+            # The XLA fallback with the early-dispatch exchange shape
+            # (begin/finish; bit-identical values) — only pipelined=True
+            # selects it (no tile split to ride).
+            from ..ops.halo import begin_slab_exchange, finish_slab_exchange
+
+            def group(i, s):
+                s = lax.fori_loop(0, fused_k, lambda j, s: xla_step(s), s)
+                pend = begin_slab_exchange(s, (0, 1, 2), width=fused_k)
+                return finish_slab_exchange(s, pend)
+
+            return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
+
         return stencil(
             lambda *s: fused_or_fallback(
-                *s, fused_block_step, xla_cadence_step, fused_zpatch_step
+                *s, fused_block_step, xla_cadence_step, fused_zpatch_step,
+                pipelined_bodies={
+                    "plain": fused_pipelined_block_step,
+                    "zpatch": fused_zpatch_pipelined_step,
+                    "xla": xla_pipelined_cadence_step,
+                },
             ),
             donate_argnums=tuple(range(4)) if donate else (),
         )
@@ -412,14 +576,27 @@ def make_multi_step(
                     P = p_update(P, Vx, Vy, Vz)
                     return (P, Vx, Vy, Vz)
 
-                P, Vx, Vy, Vz = lax.fori_loop(0, w, body, s)
-                P, Vx, Vy, Vz = update_halo(P, Vx, Vy, Vz, width=w)
-                return (P, Vx, Vy, Vz)
+                s = lax.fori_loop(0, w, body, s)
+                if pipelined:
+                    from ..ops.halo import (
+                        begin_slab_exchange,
+                        finish_slab_exchange,
+                    )
+
+                    pend = begin_slab_exchange(s, (0, 1, 2), width=w)
+                    return finish_slab_exchange(s, pend)
+                return update_halo(*s, width=w)
 
             return lax.fori_loop(0, nsteps // w, group, (P, Vx, Vy, Vz))
 
         donate_argnums = tuple(range(4)) if donate else ()
         return stencil(block_step, donate_argnums=donate_argnums)
+
+    if pipelined:
+        raise ValueError(
+            "pipelined applies to the group cadences (fused_k or "
+            "exchange_every > 1); the per-step path has no group schedule."
+        )
 
     if params.hide_comm:
         v_exchange = hide_communication(v_update, radius=1)

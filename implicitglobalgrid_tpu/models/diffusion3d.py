@@ -190,6 +190,20 @@ def make_step(params: Params, *, donate: bool = True):
     return stencil(block_step, donate_argnums=(0,) if donate else ())
 
 
+def pipelined_support_error(shape, k, itemsize: int = 4, bx=None, by=None,
+                            gg=None) -> str | None:
+    """Why the pipelined group schedule cannot split this config, or None.
+
+    The same decision the ``pipelined`` knob's auto mode makes at trace
+    time (`models._fused.pipelined_support_error` over the diffusion
+    kernel's envelope) — exported for benchmark provenance.
+    """
+    from ..ops import pallas_stencil
+    from ._fused import pipelined_support_error as _generic
+
+    return _generic(pallas_stencil, shape, k, itemsize, bx, by, gg, stagger=0)
+
+
 def make_multi_step(
     params: Params,
     nsteps: int,
@@ -198,6 +212,7 @@ def make_multi_step(
     fused_k: int | None = None,
     fused_tile: tuple[int, int] | None = None,
     exchange_every: int = 1,
+    pipelined: bool | None = None,
 ):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
@@ -229,6 +244,18 @@ def make_multi_step(
     per collective, so both the memory and the latency cost amortize.
     Requires ``nsteps % fused_k == 0`` and TPU-compatible shapes (see
     `fused_diffusion_steps`).
+
+    ``pipelined`` (default auto): run the fused groups on the
+    boundary-first pipelined schedule
+    (`models._fused.run_pipelined_group_schedule`) — each group's kernel
+    launch splits into a ring pass that feeds the slab exchange early and
+    an interior pass XLA schedules across the in-flight
+    `collective-permute`s.  Bit-identical to the serialized schedule
+    (`pipelined=False`); auto turns it on whenever the grid communicates
+    in x/y and the tile split is admissible
+    (`pipelined_support_error`).  ``pipelined=True`` also applies the
+    early-dispatch exchange shape to the XLA cadences (the fused fallback
+    and ``exchange_every``).
     """
     from jax import lax
 
@@ -276,13 +303,27 @@ def make_multi_step(
         # (w steps per width-w slab exchange — the deep halo is already
         # validated above), the reference's runtime-path-selection move
         # (`/root/reference/src/update_halo.jl:755-784`).
-        from ._fused import fused_with_xla_grad
+        from ._fused import fused_with_xla_grad, resolve_pipelined, split_selector
 
-        def fused_or_fallback(T, Cp, fused_body, xla_body, zpatch_body=None):
+        active01 = tuple(d for d in (0, 1) if d in active)
+
+        def _split(shape, itemsize, zpatch):
+            """(ring/mid selector suffix, admissibility error) for the
+            resolved tile — the shared trace-time gate (`split_selector`)."""
+            from ..ops import pallas_stencil
+
+            return split_selector(
+                pallas_stencil, shape, fused_k, fused_k, itemsize, bx, by,
+                active01, zpatch, stagger=0, gg=gg,
+            )
+
+        def fused_or_fallback(T, Cp, fused_body, xla_body, zpatch_body=None,
+                              pipelined_bodies=None):
             # Kernel paths are wrapped with `fused_with_xla_grad`: the
             # primal runs the Pallas chunk, jax.grad differentiates the
             # XLA-cadence twin (the kernels have no VJP).
             shape = tuple(T.shape)
+            pb = pipelined_bodies or {}
             if (
                 zpatch_body is not None
                 and z_active
@@ -292,11 +333,29 @@ def make_multi_step(
             ):
                 # In-kernel z-slab application (docs/performance.md's
                 # exchanged-dimension anisotropy note).
-                return fused_with_xla_grad(zpatch_body, xla_body)(T, Cp)
+                body = zpatch_body
+                if "zpatch" in pb and resolve_pipelined(
+                    pipelined, _split(shape, T.dtype.itemsize, True)[1],
+                    shape, fused_k, "diffusion",
+                ):
+                    body = pb["zpatch"]
+                return fused_with_xla_grad(body, xla_body)(T, Cp)
             err = fused_support_error(shape, fused_k, T.dtype.itemsize, bx, by)
             if err is None:
-                return fused_with_xla_grad(fused_body, xla_body)(T, Cp)
+                body = fused_body
+                # The non-zpatch pipelined split only exists on z-inactive
+                # grids (a z-DUS exchange spans every tile's rows).
+                if "plain" in pb and not z_active and resolve_pipelined(
+                    pipelined, _split(shape, T.dtype.itemsize, False)[1],
+                    shape, fused_k, "diffusion",
+                ):
+                    body = pb["plain"]
+                return fused_with_xla_grad(body, xla_body)(T, Cp)
             _warn_fused_fallback(tuple(T.shape), fused_k, err)
+            if pipelined and "xla" in pb:
+                # Explicit request: the XLA cadence with the early-dispatch
+                # exchange shape (begin/finish; bit-identical values).
+                return pb["xla"](T, Cp)
             return xla_body(T, Cp)
 
         from ._fused import run_group_schedule
@@ -304,6 +363,12 @@ def make_multi_step(
         groups = [fused_k] * (nsteps // fused_k)
 
         if not active:
+            if pipelined:
+                from ._fused import warn_pipelined_fallback
+
+                warn_pipelined_fallback(
+                    None, fused_k, "no halo activity: nothing to overlap"
+                )
 
             def fused_chunk(T, Cp):
                 T = run_group_schedule(
@@ -386,6 +451,88 @@ def make_multi_step(
             mk_apply = apply_z_patch_t if tr else apply_z_patch
             return mk_apply(T, patch, width=fused_k), Cp
 
+        def fused_pipelined_block_step(T, Cp):
+            # Boundary-first split of `fused_block_step` (z-inactive grids):
+            # the ring pass feeds the x/y slab exchange early, the interior
+            # pass runs across the in-flight collectives, the received
+            # slabs land on the aliased combined output.
+            from ..ops.halo import begin_slab_exchange, finish_slab_exchange
+            from ._fused import run_pipelined_group_schedule
+
+            sel, _, _ = _split(tuple(T.shape), T.dtype.itemsize, False)
+
+            def boundary(ki, T):
+                Tb = fused_diffusion_steps(
+                    T, Cp, ki, cx, cy, cz, bx=bx, by=by, tile_sel="ring" + sel
+                )
+                return (Tb,), begin_slab_exchange((Tb,), (0, 1), width=fused_k)
+
+            def interior(ki, T, b_out, pend):
+                T2 = fused_diffusion_steps(
+                    T, Cp, ki, cx, cy, cz, bx=bx, by=by,
+                    tile_sel="mid" + sel, carry_in=b_out,
+                )
+                (T2,) = finish_slab_exchange((T2,), pend)
+                return T2
+
+            return run_pipelined_group_schedule(groups, boundary, interior, T), Cp
+
+        def fused_zpatch_pipelined_step(T, Cp):
+            # Boundary-first split of `fused_zpatch_step`: x/y slabs of T
+            # exchange early off the ring pass; the packed z export (which
+            # every tile feeds) completes with the interior pass and its
+            # thin communication stays on the serialized tail of the group.
+            from ..ops.halo import (
+                apply_z_patch,
+                apply_z_patch_t,
+                begin_slab_exchange,
+                exchange_dims,
+                exchange_dims_t,
+                finish_slab_exchange,
+                identity_z_patch,
+                identity_z_patch_t,
+                ol,
+                z_patch_from_export,
+                z_patch_from_export_t,
+            )
+            from ..ops.pallas_stencil import zpatch_transposed
+            from ._fused import run_pipelined_group_schedule
+
+            shape = tuple(T.shape)
+            o_z = ol(2, shape=shape, gg=gg)
+            tr = zpatch_transposed(shape, fused_k, T.dtype.itemsize, bx, by)
+            sel, _, _ = _split(shape, T.dtype.itemsize, True)
+
+            def boundary(ki, carry):
+                T, patch = carry
+                b_out = fused_diffusion_steps(
+                    T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch,
+                    z_export=True, z_overlap=o_z, tile_sel="ring" + sel,
+                )
+                pend = begin_slab_exchange(b_out[:1], (0, 1), width=fused_k)
+                return b_out, pend
+
+            def interior(ki, carry, b_out, pend):
+                T, patch = carry
+                T2, zex = fused_diffusion_steps(
+                    T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch,
+                    z_export=True, z_overlap=o_z,
+                    tile_sel="mid" + sel, carry_in=b_out,
+                )
+                (T2,) = finish_slab_exchange((T2,), pend)
+                if tr:
+                    zex = exchange_dims_t(zex, width=fused_k, shape=shape)
+                    return T2, z_patch_from_export_t(zex, width=fused_k)
+                zex = exchange_dims(zex, (0, 1), width=fused_k)
+                return T2, z_patch_from_export(zex, width=fused_k)
+
+            mk_ident = identity_z_patch_t if tr else identity_z_patch
+            T, patch = run_pipelined_group_schedule(
+                groups, boundary, interior, (T, mk_ident(T, width=fused_k))
+            )
+            mk_apply = apply_z_patch_t if tr else apply_z_patch
+            return mk_apply(T, patch, width=fused_k), Cp
+
         def xla_cadence_step(T, Cp):
             def group(i, T):
                 T = lax.fori_loop(0, fused_k, lambda j, T: update(T, Cp), T)
@@ -393,9 +540,30 @@ def make_multi_step(
 
             return lax.fori_loop(0, nsteps // fused_k, group, T), Cp
 
+        def xla_pipelined_cadence_step(T, Cp):
+            # The XLA fallback with the early-dispatch exchange shape: the
+            # group's permutes depend on slab slices only (begin), the
+            # received planes land lazily (finish).  Values bit-identical
+            # to `xla_cadence_step`; there is no tile split to ride, so
+            # only `pipelined=True` selects it.
+            from ..ops.halo import begin_slab_exchange, finish_slab_exchange
+
+            def group(i, T):
+                T = lax.fori_loop(0, fused_k, lambda j, T: update(T, Cp), T)
+                pend = begin_slab_exchange((T,), (0, 1, 2), width=fused_k)
+                (T,) = finish_slab_exchange((T,), pend)
+                return T
+
+            return lax.fori_loop(0, nsteps // fused_k, group, T), Cp
+
         return stencil(
             lambda T, Cp: fused_or_fallback(
-                T, Cp, fused_block_step, xla_cadence_step, fused_zpatch_step
+                T, Cp, fused_block_step, xla_cadence_step, fused_zpatch_step,
+                pipelined_bodies={
+                    "plain": fused_pipelined_block_step,
+                    "zpatch": fused_zpatch_pipelined_step,
+                    "xla": xla_pipelined_cadence_step,
+                },
             ),
             donate_argnums=(0,) if donate else (),
         )
@@ -423,12 +591,29 @@ def make_multi_step(
         def block_step(T, Cp):
             def group(i, T):
                 T = lax.fori_loop(0, w, lambda j, T: update(T, Cp), T)
+                if pipelined:
+                    # Early-dispatch exchange shape (bit-identical values);
+                    # see the ``pipelined`` docstring note.
+                    from ..ops.halo import (
+                        begin_slab_exchange,
+                        finish_slab_exchange,
+                    )
+
+                    pend = begin_slab_exchange((T,), (0, 1, 2), width=w)
+                    (T,) = finish_slab_exchange((T,), pend)
+                    return T
                 return update_halo(T, width=w)
 
             T = lax.fori_loop(0, nsteps // w, group, T)
             return T, Cp
 
         return stencil(block_step, donate_argnums=(0,) if donate else ())
+
+    if pipelined:
+        raise ValueError(
+            "pipelined applies to the group cadences (fused_k or "
+            "exchange_every > 1); the per-step path has no group schedule."
+        )
 
     if params.hide_comm:
         overlapped = hide_communication(update, radius=1)

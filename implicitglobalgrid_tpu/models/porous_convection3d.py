@@ -333,6 +333,24 @@ def _pt_schedule(npt: int, w: int, *, even: bool = True):
     return lead, chunks
 
 
+def pipelined_support_error(shape, k, itemsize: int = 4, bx=None, by=None,
+                            gg=None, npt=None) -> str | None:
+    """Why the pipelined group schedule cannot split this config, or None
+    (benchmark provenance; see `models._fused.pipelined_support_error`).
+
+    ``npt``: when given, also require the PT schedule to admit a kernel
+    chunk at all (``npt=1`` leaves none, and the cadence then runs the XLA
+    path regardless of the split)."""
+    from ..ops import pallas_pt
+    from ._fused import pipelined_support_error as _generic
+
+    if npt is not None and not _pt_schedule(int(npt), k)[1]:
+        return f"npt={npt} leaves no even kernel chunk: XLA cadence"
+    # stagger=1: the flux fields' shape-aware ol is one deeper than the
+    # grid overlap, and their send planes must fit the ring tiles too.
+    return _generic(pallas_pt, shape, k, itemsize, bx, by, gg, stagger=1)
+
+
 def make_multi_step(
     params: Params,
     nsteps: int,
@@ -341,6 +359,7 @@ def make_multi_step(
     exchange_every: int = 1,
     fused_k: int | None = None,
     fused_tile: tuple[int, int] | None = None,
+    pipelined: bool | None = None,
 ):
     """Advance ``nsteps`` time steps per call in ONE XLA program
     (`lax.fori_loop` over whole time steps) — the production path: per-call
@@ -379,6 +398,14 @@ def make_multi_step(
     `fori_loop` costs ~35% (225 vs 357 GB/s), while fully unrolling the PT
     loop also loses (~210 GB/s, fusion blow-up).  ``nsteps`` is a small
     production chunk, so the unroll is cheap to compile.
+
+    ``pipelined`` (default auto): boundary-first pipelined group schedule
+    for the fused PT groups — ring/interior split launches with the
+    all-field slab exchange dispatched off the ring pass, exactly as on
+    `models.diffusion3d.make_multi_step` (bit-identical to the serialized
+    schedule; auto when admissible, see `pipelined_support_error`).
+    ``pipelined=True`` also applies the early-dispatch exchange shape to
+    the XLA cadences' group exchange.
     """
     from jax import lax
 
@@ -395,7 +422,7 @@ def make_multi_step(
         Pf = p_update(Pf, qDx, qDy, qDz)
         return Pf, qDx, qDy, qDz
 
-    def cadence_block_step(w, lead=0, chunks=None):
+    def cadence_block_step(w, lead=0, chunks=None, early_exchange=False):
         """One time step at the w-iterations-per-slab-exchange cadence — the
         ONE definition behind both ``exchange_every=w`` and the ``fused_k``
         branch's XLA fallback, so the fallback's bit-identical-to-cadence
@@ -427,6 +454,16 @@ def make_multi_step(
             def group(ki, s):
                 for _ in range(ki):
                     s = pt_iterate(T, s)
+                if early_exchange:
+                    # pipelined=True: the early-dispatch exchange shape
+                    # (begin/finish; bit-identical values).
+                    from ..ops.halo import (
+                        begin_slab_exchange,
+                        finish_slab_exchange,
+                    )
+
+                    pend = begin_slab_exchange(s, (0, 1, 2), width=w)
+                    return finish_slab_exchange(s, pend)
                 return update_halo(*s, width=w)
 
             s = run_group_schedule(
@@ -481,13 +518,27 @@ def make_multi_step(
         if (bx is None) != (by is None):
             raise ValueError(f"fused_tile={fused_tile}: pass both bx and by, or neither")
 
-        def kernel_iters(ki, T, Pf, qxp, qyp, qzp, z_patches=None, **zkw):
+        def kernel_iters(ki, T, Pf, qxp, qyp, qzp, z_patches=None, tile=None,
+                         **zkw):
+            # ``tile``: the pipelined paths pin every chunk to the tile the
+            # split gate validated at k=w (a shorter ragged chunk would
+            # otherwise re-resolve its own ladder default — a geometry the
+            # ring/mid admissibility check never saw).  Serialized paths
+            # keep the per-chunk ladder resolution.
+            tbx, tby = tile if tile is not None else (bx, by)
             return fused_pt_iterations(
                 T, Pf, qxp, qyp, qzp, ki, th, idx, idy, idz, ralam, bp,
-                bx=bx, by=by, z_patches=z_patches, **zkw,
+                bx=tbx, by=tby, z_patches=z_patches, **zkw,
             )
 
         if not active:
+            if pipelined:
+                from ._fused import warn_pipelined_fallback
+
+                warn_pipelined_fallback(
+                    None, w,
+                    "no halo activity: nothing to overlap", model="porous",
+                )
 
             def fused_block_step(T, Pf, qDx, qDy, qDz):
                 # Fluxes stay padded across the whole PT loop (no exchange
@@ -577,9 +628,131 @@ def make_multi_step(
                 T = update_halo(T)
                 return T, Pf, qDx, qDy, qDz
 
+            def fused_pipelined_block_step(T, Pf, qDx, qDy, qDz):
+                # Boundary-first split of `fused_block_step` (z-inactive):
+                # ring pass feeds the all-field slab exchange early,
+                # interior pass runs across the in-flight collectives.
+                from ..ops.halo import (
+                    _padded_logicals,
+                    begin_slab_exchange,
+                    finish_slab_exchange,
+                )
+                from ._fused import run_pipelined_group_schedule
+
+                for _ in range(lead):
+                    Pf, qDx, qDy, qDz = update_halo(
+                        *pt_iterate(T, (Pf, qDx, qDy, qDz))
+                    )
+                sel, _, ptile = _split(tuple(Pf.shape), Pf.dtype.itemsize, False)
+                s0 = (Pf, *pad_faces(qDx, qDy, qDz))
+                logicals = _padded_logicals(*s0)
+
+                def boundary(ki, s):
+                    out_b = kernel_iters(ki, T, *s, tile=ptile, tile_sel="ring" + sel)
+                    pend = begin_slab_exchange(
+                        out_b, (0, 1), width=w, logicals=logicals
+                    )
+                    return out_b, pend
+
+                def interior(ki, s, out_b, pend):
+                    out = kernel_iters(
+                        ki, T, *s, tile=ptile, tile_sel="mid" + sel,
+                        carry_in=out_b,
+                    )
+                    return finish_slab_exchange(out, pend, logicals=logicals)
+
+                # Same loop shaping as the serialized Pallas cadence (the
+                # unrolled-group pipelining win; only the XLA cadence needs
+                # the all-or-nothing fori shape).
+                Pf, qxp, qyp, qzp = run_pipelined_group_schedule(
+                    chunks, boundary, interior, s0
+                )
+                qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
+                T = t_update(T, qDx, qDy, qDz)
+                T = update_halo(T)
+                return T, Pf, qDx, qDy, qDz
+
+            def fused_zpatch_pipelined_step(T, Pf, qDx, qDy, qDz):
+                # Boundary-first split of `fused_zpatch_step`: the PT
+                # fields' x/y slabs exchange early off the ring pass; the
+                # packed z exports complete with the interior pass.
+                from ..ops.halo import (
+                    _padded_logicals,
+                    apply_z_patches,
+                    begin_slab_exchange,
+                    finish_slab_exchange,
+                    fix_topface_z_exports,
+                    identity_z_patches,
+                    ol,
+                    z_patches_from_exports,
+                )
+                from ._fused import run_pipelined_group_schedule
+
+                for _ in range(lead):
+                    Pf, qDx, qDy, qDz = update_halo(
+                        *pt_iterate(T, (Pf, qDx, qDy, qDz))
+                    )
+                s0 = (Pf, *pad_faces(qDx, qDy, qDz))
+                o_z = ol(2, shape=tuple(Pf.shape), gg=gg)
+                patches0 = identity_z_patches(*s0, width=w)
+                sel, _, ptile = _split(tuple(Pf.shape), Pf.dtype.itemsize, True)
+                logicals = _padded_logicals(*s0)
+
+                def boundary(ki, carry):
+                    s, patches = carry
+                    out_b = kernel_iters(
+                        ki, T, *s, z_patches=patches, z_patch_width=w,
+                        z_export=True, z_export_width=w, z_overlap=o_z,
+                        tile=ptile, tile_sel="ring" + sel,
+                    )
+                    pend = begin_slab_exchange(
+                        out_b[:4], (0, 1), width=w, logicals=logicals
+                    )
+                    return out_b, pend
+
+                def interior(ki, carry, out_b, pend):
+                    s, patches = carry
+                    out = kernel_iters(
+                        ki, T, *s, z_patches=patches, z_patch_width=w,
+                        z_export=True, z_export_width=w, z_overlap=o_z,
+                        tile=ptile, tile_sel="mid" + sel, carry_in=out_b,
+                    )
+                    s2, exports = out[:4], out[4:]
+                    exports = fix_topface_z_exports(exports, *s2, width=w)
+                    s2 = finish_slab_exchange(s2, pend, logicals=logicals)
+                    patches2 = z_patches_from_exports(
+                        exports, tuple(s2[0].shape), width=w
+                    )
+                    return s2, patches2
+
+                # Serialized-cadence loop shaping (see above).
+                s, patches = run_pipelined_group_schedule(
+                    chunks, boundary, interior, (s0, patches0)
+                )
+                Pf, qxp, qyp, qzp = apply_z_patches(*s, patches, width=w)
+                qDx, qDy, qDz = unpad_faces(qxp, qyp, qzp)
+                T = t_update(T, qDx, qDy, qDz)
+                T = update_halo(T)
+                return T, Pf, qDx, qDy, qDz
+
         xla_block_step = cadence_block_step(w, lead, chunks)
         z_active = dim_has_halo_activity(gg, 2)
-        from ._fused import fused_with_xla_grad
+        from ._fused import fused_with_xla_grad, resolve_pipelined, split_selector
+
+        active01 = tuple(d for d in (0, 1) if d in active)
+
+        def _split(shape, itemsize, zpatch):
+            """(ring/mid selector suffix, admissibility error) — the shared
+            trace-time gate (`split_selector`; stagger=1 for the flux
+            fields).  The ragged schedule keeps patch/export widths at
+            ``w`` for every chunk, so the split is gated at the worst case
+            ``ki = w`` too."""
+            from ..ops import pallas_pt
+
+            return split_selector(
+                pallas_pt, shape, w, w, itemsize, bx, by,
+                active01, zpatch, stagger=1, gg=gg,
+            )
 
         def block_step(T, Pf, qDx, qDy, qDz):
             # Shapes are only known at trace time, so the kernel-vs-fallback
@@ -597,17 +770,33 @@ def make_multi_step(
                 ) is None
             ):
                 # In-kernel z-slab application (see docs/performance.md).
-                return fused_with_xla_grad(fused_zpatch_step, xla_block_step)(
+                body = fused_zpatch_step
+                if resolve_pipelined(
+                    pipelined, _split(shape, Pf.dtype.itemsize, True)[1],
+                    shape, w, "porous",
+                ):
+                    body = fused_zpatch_pipelined_step
+                return fused_with_xla_grad(body, xla_block_step)(
                     T, Pf, qDx, qDy, qDz
                 )
             err = fused_support_error(shape, w, Pf.dtype.itemsize, bx, by)
             if err is None and not chunks:
                 err = f"npt={npt} leaves no even kernel chunk"
             if err is None:
-                return fused_with_xla_grad(fused_block_step, xla_block_step)(
+                body = fused_block_step
+                if active and not z_active and resolve_pipelined(
+                    pipelined, _split(shape, Pf.dtype.itemsize, False)[1],
+                    shape, w, "porous",
+                ):
+                    body = fused_pipelined_block_step
+                return fused_with_xla_grad(body, xla_block_step)(
                     T, Pf, qDx, qDy, qDz
                 )
             warn_fused_fallback(tuple(Pf.shape), w, err, model="porous")
+            if pipelined:
+                return cadence_block_step(w, lead, chunks, early_exchange=True)(
+                    T, Pf, qDx, qDy, qDz
+                )
             return xla_block_step(T, Pf, qDx, qDy, qDz)
 
     elif exchange_every < 1:
@@ -623,10 +812,17 @@ def make_multi_step(
             )
         require_deep_halo(exchange_every)
         block_step = cadence_block_step(
-            exchange_every, *_pt_schedule(npt, exchange_every, even=False)
+            exchange_every, *_pt_schedule(npt, exchange_every, even=False),
+            early_exchange=bool(pipelined),
         )
 
     else:
+        if pipelined:
+            raise ValueError(
+                "pipelined applies to the group cadences (fused_k or "
+                "exchange_every > 1); the per-iteration path has no group "
+                "schedule."
+            )
         block_step = _build_block_step(params)
 
     # The Python unroll is only cheap for production-sized chunks; past this

@@ -143,6 +143,50 @@ def make_tile_error(tile_bytes, budget, desc, full_y_ok=False):
     return tile_error
 
 
+def check_tile_subset(tile_sel, carry_in, n01, tile, nouts: int):
+    """Validate a tile-subset launch request (shared by the three kernels).
+
+    ``tile_sel``/``carry_in`` as documented on each kernel's public entry;
+    ``n01`` = (n0, n1), ``tile`` = (bx, by), ``nouts`` = the launch's output
+    count (what a ``mid*`` carry must alias).  Returns ``carry_in``
+    normalized to a tuple, or None for non-aliasing launches.
+    """
+    if tile_sel == "all":
+        if carry_in is not None:
+            raise ValueError("carry_in is only for 'mid*' tile-subset launches")
+        return None
+    from .overlap import TILE_SELS, tile_subset_count
+
+    if tile_sel not in TILE_SELS:
+        raise ValueError(f"tile_sel {tile_sel!r} must be one of {TILE_SELS}")
+    ncx, ncy = n01[0] // tile[0], n01[1] // tile[1]
+    n = tile_subset_count(tile_sel, ncx, ncy)
+    if n < 2:
+        # The kernels' double-buffered DMA drain assumes >= 2 tiles; the
+        # models gate admissibility through `ops.overlap.tile_split_error`,
+        # so reaching this is a caller bug, not a fall-back condition.
+        raise ValueError(
+            f"tile subset {tile_sel!r} has {n} tiles on the ({ncx},{ncy}) "
+            "tile grid; a subset launch needs >= 2"
+        )
+    if tile_sel.startswith("mid"):
+        if carry_in is None:
+            raise ValueError(
+                "a 'mid*' launch needs carry_in: the matching 'ring*' "
+                "launch's output array(s) to alias the combined result into"
+            )
+        carry = tuple(carry_in) if isinstance(carry_in, (tuple, list)) else (carry_in,)
+        if len(carry) != nouts:
+            raise ValueError(
+                f"carry_in must hold the ring launch's {nouts} output(s); "
+                f"got {len(carry)}"
+            )
+        return carry
+    if carry_in is not None:
+        raise ValueError("carry_in is only for 'mid*' tile-subset launches")
+    return None
+
+
 def default_tile(shape, k, itemsize, *, tile_error, candidates):
     """First candidate ``tile_error`` accepts for ``shape``, or None."""
     n0, n1, n2 = shape

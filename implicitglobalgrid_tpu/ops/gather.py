@@ -10,15 +10,19 @@ already* the block-ordered global array (one block per device), so:
 
 * single process: gather is a host transfer (`jax.device_get`) — no
   collective at all;
-* multi-host: blocks are fetched ONE AT A TIME with a compiled masked
-  all-reduce (`_block_fetch_fn`) and placed into the output immediately on
-  the root; non-root processes never fetch anything to the host.  Per-process
-  memory bound (matching the reference's root-only design): the root holds
-  the assembled global array plus one staged block; every other process pays
-  ZERO extra host bytes and one transient block per device — never the
-  global array.  The round-4 implementation (`process_allgather(tiled=True)`)
-  materialized the full global array on EVERY process, which at pod scale
-  (512^3 f32 x 256 chips ~ 137 GB) OOMs every host; this path replaces it.
+* multi-host: blocks are fetched a small BATCH at a time with a compiled
+  masked all-reduce (`_block_fetch_fn`; batch size `_gather_batch_size`,
+  default 8, env ``IGG_GATHER_BATCH``) and placed into the output
+  immediately on the root; non-root processes never fetch anything to the
+  host.  Batching amortizes the host-synchronized dispatch of the
+  ``prod(dims)`` sequential collectives a pod-scale gather performs.
+  Per-process memory bound (matching the reference's root-only design): the
+  root holds the assembled global array plus one staged batch of blocks;
+  every other process pays ZERO extra host bytes and one transient batch
+  per device — never the global array.  The round-4 implementation
+  (`process_allgather(tiled=True)`) materialized the full global array on
+  EVERY process, which at pod scale (512^3 f32 x 256 chips ~ 137 GB) OOMs
+  every host; this path replaces it.
 
 Like the reference, no halo de-duplication is performed — the result is the
 blocks side by side; strip halos first with `block_slice` if needed
@@ -46,30 +50,32 @@ def _clear_caches() -> None:
     _fetch_cache.clear()
 
 
-def _block_fetch_fn(gg, ndim: int, block_shape, dtype):
-    """Compiled per-block fetch: replicate block ``sel`` onto every device.
+def _block_fetch_fn(gg, ndim: int, block_shape, dtype, nsel: int = 1):
+    """Compiled block fetch: replicate blocks ``sels`` onto every device.
 
-    One masked all-reduce: the owning device contributes its local block,
-    everyone else zeros, `psum` over the field's mesh axes replicates the
-    block.  This is the memory-scalable primitive behind the multi-host
-    gather — device transient = ONE block, host transient = one block on the
-    root only (vs `process_allgather`'s full global array everywhere).  The
-    block index ``sel`` is a traced scalar, so all ``prod(dims)`` fetches
-    share one executable.
+    One masked all-reduce: the owning devices contribute their local
+    blocks, everyone else zeros, `psum` over the field's mesh axes
+    replicates the batch.  This is the memory-scalable primitive behind the
+    multi-host gather — device transient = ``nsel`` blocks, host transient
+    = ``nsel`` blocks on the root only (vs `process_allgather`'s full
+    global array everywhere).  The block indices ``sels`` (an ``(nsel,)``
+    vector) are traced, so all batches of one size share one executable;
+    ``nsel > 1`` amortizes the per-dispatch host sync of the chunked
+    gather over several blocks per collective (`_gather_batch`).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    key = (gg.epoch, ndim, tuple(block_shape), str(dtype))
+    key = (gg.epoch, ndim, tuple(block_shape), str(dtype), int(nsel))
     fn = _fetch_cache.get(key)
     if fn is not None:
         return fn
     axes = AXIS_NAMES[:ndim]
     dims = gg.dims[:ndim]
 
-    def local(a, sel):
+    def local(a, sels):
         my = jnp.int32(0)
         for ax, nd in zip(axes, dims):
             my = my * nd + lax.axis_index(ax)
@@ -83,7 +89,10 @@ def _block_fetch_fn(gg, ndim: int, block_shape, dtype):
         if cplx:
             a = jnp.stack((a.real, a.imag), axis=-1)
         bits = lax.bitcast_convert_type(a, _word_dtype(a.dtype))
-        contrib = jnp.where(my == sel, bits, jnp.zeros_like(bits))
+        # One leading batch axis, masked per selected block; a block
+        # appears at the batch slot(s) whose sel it owns.
+        mask = (sels == my).reshape((nsel,) + (1,) * bits.ndim)
+        contrib = jnp.where(mask, bits[None], jnp.zeros_like(bits)[None])
         # psum over the field's own axes only: fields of lower rank than the
         # mesh are replicated over the remaining axes, and summing those
         # would multiply the block by the replica count.
@@ -98,7 +107,7 @@ def _block_fetch_fn(gg, ndim: int, block_shape, dtype):
         local,
         mesh=gg.mesh,
         in_specs=(P(*axes), P()),
-        out_specs=P(*([None] * ndim)),
+        out_specs=P(*([None] * (ndim + 1))),
         check_vma=False,
     )
     fn = jax.jit(mapped, out_shardings=NamedSharding(gg.mesh, P()))
@@ -115,14 +124,31 @@ def _word_dtype(dtype):
     return jnp.dtype(f"uint{8 * jnp.dtype(dtype).itemsize}")
 
 
-def _gather_chunked(A, gg, out: np.ndarray | None):
-    """Block-by-block multi-host assembly (reference root-only memory bound).
+def _gather_batch_size() -> int:
+    """Blocks fetched per compiled dispatch in `_gather_chunked`.
 
-    Collective: every process iterates the same block sequence (the
+    At pod scale the chunked gather's cost is ``prod(dims)`` sequential
+    host-synchronized collectives; batching ``B`` blocks per dispatch
+    amortizes the per-dispatch sync ``B``-fold while the root's transient
+    grows to ``B`` blocks (still nowhere near the full global array) and
+    non-roots keep paying ZERO host bytes.  ``IGG_GATHER_BATCH`` overrides
+    (min 1); the default 8 keeps the root transient below one typical
+    block-row.
+    """
+    from ..utils.config import _int_env
+
+    val = _int_env("IGG_GATHER_BATCH")
+    return max(int(val), 1) if val is not None else 8
+
+
+def _gather_chunked(A, gg, out: np.ndarray | None):
+    """Batched block-by-block multi-host assembly (root-only memory bound).
+
+    Collective: every process iterates the same batch sequence (the
     reference's non-roots likewise all participate by sending,
     `/root/reference/src/gather.jl:33-36`).  The root (the one process with
-    ``out is not None``) places each block as it arrives; the replicated
-    device copy is dropped before the next fetch.
+    ``out is not None``) places each batch's blocks as they arrive; the
+    replicated device copy is dropped before the next fetch.
     """
     import jax
 
@@ -130,25 +156,36 @@ def _gather_chunked(A, gg, out: np.ndarray | None):
     ndim = A.ndim
     bshape = _local_shape(A, gg)
     dims = gg.dims[:ndim]
-    fetch = _block_fetch_fn(gg, ndim, bshape, A.dtype)
+    idxs = list(np.ndindex(*dims)) or [()]
+    batch = min(_gather_batch_size(), len(idxs))
     host_bytes = 0
     nfetch = 0
-    for idx in np.ndindex(*dims):
-        sel = np.ravel_multi_index(idx, dims) if dims else 0
-        blk = fetch(A, np.int32(sel))
+    for start in range(0, len(idxs), batch):
+        chunk = idxs[start : start + batch]
+        sels = np.asarray(
+            [np.ravel_multi_index(idx, dims) if idx else 0 for idx in chunk],
+            np.int32,
+        )
+        # At most two executables total: the full batch size and one ragged
+        # tail size (both cached in `_fetch_cache`).
+        fetch = _block_fetch_fn(gg, ndim, bshape, A.dtype, nsel=len(chunk))
+        blk = fetch(A, sels)
         # EVERY process completes each fetch before dispatching the next —
         # not just the root (whose host copy syncs implicitly).  Without
-        # this, non-roots enqueue all fetches asynchronously: up to
-        # dims-many identical collectives in flight, which (a) starves the
-        # single-core CPU mesh's rendezvous and (b) can cross-match on
-        # transports without per-op channels (observed as intermittent
-        # wrong fill-in-place gathers under the gloo backend — the root's
-        # assembled bytes mixed blocks).  One outstanding collective per
-        # process is also what the docstring's memory bound promises.
+        # this, non-roots enqueue all fetches asynchronously: many identical
+        # collectives in flight, which (a) starves the single-core CPU
+        # mesh's rendezvous and (b) can cross-match on transports without
+        # per-op channels (observed as intermittent wrong fill-in-place
+        # gathers under the gloo backend — the root's assembled bytes mixed
+        # blocks).  One outstanding collective per process is also what the
+        # docstring's memory bound promises.
         jax.block_until_ready(blk)
         if out is not None:  # the root, assembling (see `gather`)
             data = np.asarray(blk.addressable_shards[0].data)
-            out[tuple(slice(c * b, (c + 1) * b) for c, b in zip(idx, bshape))] = data
+            for j, idx in enumerate(chunk):
+                out[
+                    tuple(slice(c * b, (c + 1) * b) for c, b in zip(idx, bshape))
+                ] = data[j]
             host_bytes += data.nbytes
             del data
         del blk
@@ -157,6 +194,8 @@ def _gather_chunked(A, gg, out: np.ndarray | None):
         "path": "chunked",
         "host_bytes": host_bytes,
         "fetches": nfetch,
+        "blocks": len(idxs),
+        "batch": batch,
         "block_bytes": int(np.prod(bshape)) * np.dtype(A.dtype).itemsize,
     }
     return out

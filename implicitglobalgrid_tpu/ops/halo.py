@@ -259,7 +259,36 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None, axis=None) -> "ja
     return A
 
 
-def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None):
+def _patch_slab(slab, d: int, start: int, width: int, received, shp):
+    """Overwrite a dim-``d`` slab's earlier-dim halo strips with received
+    values — the sequential-dimension corner carry-over
+    (`/root/reference/src/update_halo.jl:40`) applied at slab granularity.
+
+    ``slab`` was sliced from the field at plane range ``[start,
+    start+width)`` along ``d``; ``received`` maps each already-exchanged
+    dim ``d2 < d`` to its ``(lo, hi)`` receive slabs (full field extent
+    along ``d``); ``shp`` is the field's logical shape (the hi-strip
+    offset, like `_set_plane`'s in `_exchange_dim`).  This makes
+    `begin_slab_exchange`'s sends bit-identical to slicing the
+    sequentially-updated array.
+    """
+    from jax import lax
+
+    for d2, (lo2, hi2) in received.items():
+        if d2 >= slab.ndim:
+            continue
+        w2 = lo2.shape[d2]
+        strip = lax.slice_in_dim(lo2, start, start + width, axis=d)
+        off = [0] * slab.ndim
+        slab = lax.dynamic_update_slice(slab, strip.astype(slab.dtype), off)
+        strip = lax.slice_in_dim(hi2, start, start + width, axis=d)
+        off[d2] = shp[d2] - w2
+        slab = lax.dynamic_update_slice(slab, strip.astype(slab.dtype), off)
+    return slab
+
+
+def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None,
+                      received=None):
     """The two slabs a ``d``-exchange of ``A`` would write, without writing.
 
     Returns ``(lo_vals, hi_vals)`` — the values destined for planes
@@ -269,6 +298,10 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None):
     z-patch path (`z_slab_patches`) uses the values directly, applying them
     in VMEM where the minor-dim plane surgery is free (see
     docs/performance.md's exchanged-dimension anisotropy note).
+
+    ``received`` (the `begin_slab_exchange` path): earlier dims' receive
+    slabs, patched into this dim's send/keep slabs via `_patch_slab` so the
+    sends equal those sliced from a sequentially-updated array.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -305,13 +338,19 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None):
     # `GlobalGrid.neighbors` (`parallel/topology.py:neighbors_table`):
     # send_lo goes to ``neighbors[0, d]`` (coordinate - disp), send_hi to
     # ``neighbors[1, d]``.
+    def slab(start):
+        s = _get_plane(A, start, ax, width)
+        if received:
+            s = _patch_slab(s, ax, start, width, received, shp)
+        return s
+
     if _partner_self(gg, d):
         # Every block is its own partner (periodic wrap disp%nd==0, the
         # reference's self-neighbor fast path generalized, or disp==0):
         # pure local copy (reference: update_halo.jl:57-63).
         return (
-            _get_plane(A, n - o, ax, width),      # -> planes [0, width)
-            _get_plane(A, o - width, ax, width),  # -> planes [n-width, n)
+            slab(n - o),      # -> planes [0, width)
+            slab(o - width),  # -> planes [n-width, n)
         )
 
     # Slabs go to the lower partner's top ``width`` planes / the upper
@@ -319,10 +358,10 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None):
     # generalized from one plane to a slab).
     return _permute_slabs(
         gg, d,
-        send_lo=_get_plane(A, o - width, ax, width),
-        send_hi=_get_plane(A, n - o, ax, width),
-        keep_lo=lambda: _get_plane(A, 0, ax, width),
-        keep_hi=lambda: _get_plane(A, n - width, ax, width),
+        send_lo=slab(o - width),
+        send_hi=slab(n - o),
+        keep_lo=lambda: slab(0),
+        keep_hi=lambda: slab(n - width),
     )
 
 
@@ -541,6 +580,70 @@ def exchange_dims(A, dims, *, width: int = 1, logical=None):
     for d in dims:
         A = _exchange_dim(A, d, gg, width, logical=logical)
     return A
+
+
+# --- Early-dispatch slab exchange (pipelined group schedule) ----------------
+
+
+def begin_slab_exchange(fields, dims, *, width: int, logicals=None):
+    """Start the slab exchange of ``fields`` along ``dims`` WITHOUT writing
+    the received planes back.
+
+    The pipelined group schedule's early-exchange entry: called on the
+    boundary pass's outputs — which own every send plane — so the
+    `collective-permute`s dispatch with only thin slab slices as
+    dependencies and fly while the interior pass computes.  Sequential-
+    dimension corner semantics are preserved at slab granularity: each
+    dim-``d`` send (and PROC_NULL keep) slab is patched with the dims
+    ``< d`` receive strips from THIS call (`_patch_slab`), exactly the
+    values a serialized per-dim exchange would have sliced.  Returns one
+    ``pend`` list per field — ``[(d, lo_vals, hi_vals), ...]`` over the
+    dims that actually exchange — for `finish_slab_exchange`.
+
+    ``finish_slab_exchange(fields', pends)`` on arrays holding the same
+    owned values is bit-identical to the serialized exchange
+    (`exchange_dims` / `update_halo_padded_faces`) over the same dims.
+    ``logicals``: per-field REAL shapes for padded layouts (as in
+    `_exchange_dim`).  Traced-context only, like `exchange_dims`.
+    """
+    gg = _grid.global_grid()
+    if logicals is None:
+        logicals = (None,) * len(fields)
+    pends = []
+    for A, logical in zip(fields, logicals):
+        received: dict = {}
+        pend = []
+        for d in dims:
+            vals = _slab_recv_values(
+                A, d, gg, width, logical, received=received
+            )
+            if vals is None:
+                continue
+            received[d] = vals
+            pend.append((d, vals[0], vals[1]))
+        pends.append(pend)
+    return pends
+
+
+def finish_slab_exchange(fields, pends, *, logicals=None):
+    """Apply `begin_slab_exchange`'s received slabs to ``fields``.
+
+    ``fields`` may be later arrays than the ones `begin_slab_exchange` saw
+    (the pipelined schedule finishes on the combined boundary+interior
+    output) as long as they hold the same owned values.  Returns the
+    updated tuple.
+    """
+    if logicals is None:
+        logicals = (None,) * len(fields)
+    out = []
+    for A, pend, logical in zip(fields, pends, logicals):
+        shp = logical if logical is not None else tuple(A.shape)
+        for d, lo, hi in pend:
+            w = lo.shape[d]
+            A = _set_plane(A, hi, shp[d] - w, d)
+            A = _set_plane(A, lo, 0, d)
+        out.append(A)
+    return tuple(out)
 
 
 def z_patch_from_export(export, *, width: int):

@@ -39,6 +39,155 @@ from ..parallel.topology import NDIMS
 from . import halo as _halo
 
 
+# --- Boundary/interior tile decomposition (pipelined group schedule) --------
+#
+# The fused cadences' pipelined schedule splits each group's kernel launch
+# into a BOUNDARY pass over the "ring" tiles — the tiles whose owned blocks
+# contain the x/y slab-exchange send planes and whose haloed windows read
+# the planes the exchange refreshes — and an INTERIOR pass over the "mid"
+# tiles, whose k-step outputs provably never touch a refreshed plane.  The
+# boundary pass runs first, so the group's `collective-permute`s dispatch
+# with only thin slab slices as dependencies and fly while the interior
+# pass computes (the same boundary-first scheduling `hide_communication`
+# gives the per-step XLA path, lifted to tile granularity).  ONE
+# implementation here, shared by the three Pallas kernels (traced index
+# maps) and the models' cadence builders (admissibility) so the
+# decomposition can never drift between the launch geometry and the
+# schedule that relies on it.
+
+#: Valid tile-subset selectors: "all", or ring/mid over the split dims —
+#: "0" (x-edge rows), "1" (y-edge columns), "01" (the full ring).
+TILE_SELS = ("all", "ring0", "mid0", "ring1", "mid1", "ring01", "mid01")
+
+
+def tile_subset_count(sel: str, ncx: int, ncy: int) -> int:
+    """Number of tiles in subset ``sel`` of an ``(ncx, ncy)`` tile grid."""
+    if sel == "all":
+        return ncx * ncy
+    if sel == "ring0":
+        return 2 * ncy
+    if sel == "mid0":
+        return (ncx - 2) * ncy
+    if sel == "ring1":
+        return 2 * ncx
+    if sel == "mid1":
+        return ncx * (ncy - 2)
+    if sel == "ring01":
+        return 2 * ncy + 2 * (ncx - 2)
+    if sel == "mid01":
+        return (ncx - 2) * (ncy - 2)
+    raise ValueError(f"unknown tile subset {sel!r}; one of {TILE_SELS}")
+
+
+def tile_subset_map(sel: str, ncx: int, ncy: int):
+    """Traced index map for subset ``sel``: ``t_of(i) -> flat tile index``.
+
+    ``i`` iterates ``[0, tile_subset_count(sel, ...))``; the returned flat
+    index feeds the kernels' existing ``(t // ncy, t % ncy)`` decomposition.
+    Works on both traced int32 scalars and Python ints (the kernels use
+    Python ints for the static DMA-drain indices).
+    """
+    def where(cond, a, b):
+        if isinstance(cond, bool):
+            return a if cond else b
+        import jax.numpy as jnp
+
+        return jnp.where(cond, a, b)
+
+    if sel == "all":
+        return lambda i: i
+    if sel == "ring0":
+        # x-edge rows: ix=0 then ix=ncx-1, all iy.
+        return lambda i: where(i < ncy, i, (ncx - 1) * ncy + (i - ncy))
+    if sel == "mid0":
+        return lambda i: ncy + i
+    if sel == "ring1":
+        # y-edge columns: alternating iy=0 / iy=ncy-1 per ix.
+        return lambda i: (i // 2) * ncy + (i % 2) * (ncy - 1)
+    if sel == "mid1":
+        return lambda i: (i // (ncy - 2)) * ncy + 1 + i % (ncy - 2)
+    if sel == "ring01":
+        # the full ring: both x-edge rows, then the two y-edge columns of
+        # the interior x range (alternating iy=0 / iy=ncy-1).
+        def t_of(i):
+            j = i - 2 * ncy
+            side = (1 + j // 2) * ncy + (j % 2) * (ncy - 1)
+            return where(
+                i < ncy,
+                i,
+                where(i < 2 * ncy, (ncx - 1) * ncy + (i - ncy), side),
+            )
+
+        return t_of
+    if sel == "mid01":
+        return lambda i: (1 + i // (ncy - 2)) * ncy + 1 + i % (ncy - 2)
+    raise ValueError(f"unknown tile subset {sel!r}; one of {TILE_SELS}")
+
+
+def tile_split_error(shape, k: int, width: int, bx: int, by: int, H: int,
+                     active_dims, *, ox: int, oy: int) -> str | None:
+    """Why the ring/mid tile split cannot pipeline this config, or None.
+
+    ``active_dims``: the x/y grid dimensions with halo activity (subset of
+    ``(0, 1)``).  ``ox``/``oy``: the MAXIMUM shape-aware overlap of any
+    exchanged field along x/y (grid overlap, +1 for staggered fields).
+    Conditions, per active dim:
+
+    * at least 3 tiles (a ring needs two edges plus a non-empty interior);
+    * the slab exchange's send and keep planes (indices ``< o`` from
+      either edge) must lie inside the ring tiles' owned rows — ``ox <=
+      bx`` / ``oy <= by`` — or `begin_slab_exchange` would slice planes
+      the boundary pass never wrote (deeper-than-minimum overlaps);
+    * the interior tiles' haloed windows (including the staggered kernels'
+      one-extra-face read) must stay clear of the ``width`` outermost
+      planes — the planes the slab exchange refreshes — which needs
+      ``bx >= k + width`` / ``by >= H + width``.
+
+    Both passes also need >= 2 tiles (the kernels' double-buffered DMA
+    drain assumes it).
+    """
+    n0, n1, _ = shape
+    if not active_dims:
+        return "no x/y halo activity: nothing for the interior pass to overlap"
+    ncx, ncy = n0 // bx, n1 // by
+    if 0 in active_dims:
+        if ncx < 3:
+            return f"x split needs >= 3 x-tiles (ncx={ncx} at bx={bx})"
+        if ox > bx:
+            return (
+                f"x send/keep planes reach past the ring tiles: overlap "
+                f"{ox} > bx={bx}"
+            )
+        if bx < k + width:
+            return (
+                f"interior windows reach the refreshed x planes: bx={bx} < "
+                f"k+width={k + width}"
+            )
+    if 1 in active_dims:
+        if ncy < 3:
+            return f"y split needs >= 3 y-tiles (ncy={ncy} at by={by})"
+        if oy > by:
+            return (
+                f"y send/keep planes reach past the ring tiles: overlap "
+                f"{oy} > by={by}"
+            )
+        if by < H + width:
+            return (
+                f"interior windows reach the refreshed y planes: by={by} < "
+                f"H+width={H + width}"
+            )
+    sel = "".join(str(d) for d in sorted(active_dims))
+    for kind in ("ring", "mid"):
+        if tile_subset_count(kind + sel, ncx, ncy) < 2:
+            return f"{kind}{sel} has < 2 tiles (ncx={ncx}, ncy={ncy})"
+    return None
+
+
+def tile_split_sel(active_dims) -> str:
+    """The ring/mid selector suffix for the given active x/y dims."""
+    return "".join(str(d) for d in sorted(active_dims))
+
+
 def hide_communication(update_fn=None, *, radius: int = 1, exchange=None):
     """Wrap ``update_fn`` so its halo update overlaps its interior computation.
 

@@ -232,7 +232,8 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
                          b: float, idx: float, idy: float, idz: float,
                          *, bx: int | None = None, by: int | None = None,
                          z_patches=None, z_export: bool = False,
-                         z_overlap: int | None = None):
+                         z_overlap: int | None = None,
+                         tile_sel: str = "all", carry_in=None):
     """Advance ``k`` (even) leapfrog steps in one HBM pass per field.
 
     ``P`` is the cell-centered pressure ``(n0, n1, n2)``; ``Vxp/Vyp/Vzp`` are
@@ -262,6 +263,13 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
     from the output arrays (`ops.halo.fix_topface_z_exports`), and on
     x/y-active grids the exports' own x/y slab exchange refreshes them
     anyway.
+
+    ``tile_sel``/``carry_in``: tile-subset launch for the pipelined group
+    schedule, exactly as on `ops.pallas_stencil.fused_diffusion_steps` — a
+    ``"mid*"`` launch aliases the matching ``"ring*"`` launch's outputs
+    (``carry_in``, all 4 or 7 of them) so the combined result needs no
+    copy.  The frozen top-face fix-up DMAs run in the ring pass only (the
+    alias carries their planes through the mid pass).
     """
     n0, n1, n2 = P.shape
     if (Vxp.shape, Vyp.shape, Vzp.shape) != padded_face_shapes(P.shape):
@@ -303,29 +311,48 @@ def fused_leapfrog_steps(P, Vxp, Vyp, Vzp, k: int,
         bx, by = default_tile(
             (n0, n1, n2), k, P.dtype.itemsize, zpatch=zp, zexport=z_export
         )
+    carry_in = _envelope.check_tile_subset(
+        tile_sel, carry_in, (n0, n1), (bx, by), nouts=7 if z_export else 4
+    )
+    from ..utils.compat import pallas_interpret_active
+
     fn = _build(n0, n1, n2, str(P.dtype), int(k),
                 float(cax), float(cay), float(caz),
                 float(b), float(idx), float(idy), float(idz),
                 int(bx), int(by), zp,
-                bool(z_export), int(z_overlap) if z_export else 0)
-    if zp:
-        return fn(P, Vxp, Vyp, Vzp, *z_patches)
-    return fn(P, Vxp, Vyp, Vzp)
+                bool(z_export), int(z_overlap) if z_export else 0,
+                str(tile_sel), carry_in is not None,
+                pallas_interpret_active())
+    args = (P, Vxp, Vyp, Vzp) + (tuple(z_patches) if zp else ())
+    if carry_in is not None:
+        args += tuple(carry_in)
+    return fn(*args)
 
 
 @functools.lru_cache(maxsize=64)
 def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
-           zp: bool = False, zx: bool = False, o: int = 0):
+           zp: bool = False, zx: bool = False, o: int = 0,
+           tile_sel: str = "all", carry: bool = False, interp: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from ..utils.compat import pallas_compiler_params
+    from .overlap import tile_subset_count, tile_subset_map
 
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     SZ = n2
     ncx, ncy = n0 // bx, n1 // by
     ntiles = ncx * ncy
+    # Tile-subset launch (see ops/pallas_stencil.py): the loop runs over the
+    # subset's index space; per-tile work is unchanged.  The frozen top-face
+    # fix-up DMAs belong to the ring pass (the mid pass's aliased outputs
+    # already carry those planes).
+    nrun = tile_subset_count(tile_sel, ncx, ncy)
+    t_of = tile_subset_map(tile_sel, ncx, ncy)
+    fixup = not tile_sel.startswith("mid")
     dt_ = jnp.dtype(dtype)
 
     def sx_of(ix):
@@ -399,15 +426,16 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
 
     def kernel(*refs):
         ZXcz = ZXx = ZXy = None
-        if zp and zx:
-            (Pin, Vxin, Vyin, Vzin, ZPcz, ZPx, ZPy,
-             Pout, Vxout, Vyout, Vzout, ZXcz, ZXx, ZXy) = refs
-        elif zp:
-            (Pin, Vxin, Vyin, Vzin, ZPcz, ZPx, ZPy,
-             Pout, Vxout, Vyout, Vzout) = refs
+        Pin, Vxin, Vyin, Vzin = refs[:4]
+        ZPcz, ZPx, ZPy = refs[4:7] if zp else (None, None, None)
+        nin = 7 if zp else 4
+        # A carry launch receives the ring pass's outputs as aliased inputs
+        # between the real inputs and the outputs; never read here.
+        outs = refs[nin + ((7 if zx else 4) if carry else 0):]
+        if zx:
+            Pout, Vxout, Vyout, Vzout, ZXcz, ZXx, ZXy = outs
         else:
-            Pin, Vxin, Vyin, Vzin, Pout, Vxout, Vyout, Vzout = refs
-            ZPcz = ZPx = ZPy = None
+            Pout, Vxout, Vyout, Vzout = outs
 
         def body(p, vx, vy, vz, sp, svx, svy, svz,
                  p_is, vx_is, vy_is, vz_is, p_os, vx_os, vy_os, vz_os, fix_s,
@@ -531,23 +559,25 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                 Vyout.at[pl.ds(0, n0), pl.ds(n1, 8)],
                 fix_s.at[1],
             )
-            fix_vx.start()
-            fix_vy.start()
-            start_in(0, 0)
+            if fixup:
+                fix_vx.start()
+                fix_vy.start()
+            start_in(t_of(0), 0)
 
-            def tile(t, _):
-                slot = jax.lax.rem(t, 2)
+            def tile(i, _):
+                t = t_of(i)
+                slot = jax.lax.rem(i, 2)
                 nslot = 1 - slot
 
-                @pl.when(t + 1 < ntiles)
+                @pl.when(i + 1 < nrun)
                 def _():
-                    @pl.when(t >= 1)
+                    @pl.when(i >= 1)
                     def _():
-                        # nslot still holds tile t-1's output; fence its
-                        # out-DMAs before prefetching into it.
-                        wait_out(t - 1, nslot)
+                        # nslot still holds the previous tile's output;
+                        # fence its out-DMAs before prefetching into it.
+                        wait_out(t_of(i - 1), nslot)
 
-                    start_in(t + 1, nslot)
+                    start_in(t_of(i + 1), nslot)
 
                 wait_in(t, slot)
                 if zp:
@@ -610,13 +640,14 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
                 start_out(t, slot)
                 return 0
 
-            jax.lax.fori_loop(0, ntiles, tile, 0)
-            # Drain the two in-flight out-DMA sets (ntiles >= 2 by
-            # validation; distinct slots).
-            wait_out(ntiles - 2, (ntiles - 2) % 2)
-            wait_out(ntiles - 1, (ntiles - 1) % 2)
-            fix_vx.wait()
-            fix_vy.wait()
+            jax.lax.fori_loop(0, nrun, tile, 0)
+            # Drain the two in-flight out-DMA sets (every launch runs >= 2
+            # tiles by validation; distinct slots).
+            wait_out(t_of(nrun - 2), (nrun - 2) % 2)
+            wait_out(t_of(nrun - 1), (nrun - 1) % 2)
+            if fixup:
+                fix_vx.wait()
+                fix_vy.wait()
 
         scopes = dict(
             p=pltpu.VMEM((2, SX, SY, SZ), dt_),
@@ -664,12 +695,19 @@ def _build(n0, n1, n2, dtype, k, cax, cay, caz, b, idx, idy, idz, bx, by,
         out_shape += [
             jax.ShapeDtypeStruct(s, dt_) for s in z_patch_shapes((n0, n1, n2))
         ]
+    nbase = 7 if zp else 4
+    nouts = len(out_shape)
     call = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (7 if zp else 4),
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(out_shape),
-        compiler_params=pltpu.CompilerParams(
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)]
+        * (nbase + (nouts if carry else 0)),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nouts,
+        input_output_aliases=(
+            {nbase + j: j for j in range(nouts)} if carry else {}
+        ),
+        interpret=interp,
+        compiler_params=pallas_compiler_params(
             vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)
         ),
     )

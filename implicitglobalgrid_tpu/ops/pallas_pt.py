@@ -134,7 +134,8 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
                         *, bx: int | None = None, by: int | None = None,
                         z_patches=None, z_patch_width: int | None = None,
                         z_export: bool = False, z_export_width: int | None = None,
-                        z_overlap: int | None = None):
+                        z_overlap: int | None = None,
+                        tile_sel: str = "all", carry_in=None):
     """Advance ``k`` (even) PT relaxation iterations in one HBM pass per field.
 
     ``T``/``Pf`` are cell-centered ``(n0, n1, n2)``; ``qxp/qyp/qzp`` are the
@@ -160,6 +161,10 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
     the previous chunk's ``w``-deep stale rind and exports ``w``-deep
     slabs.  Requires ``k <= width`` and ``o >= z_export_width + k`` (the
     exported planes must be exact after ``k`` steps).
+
+    ``tile_sel``/``carry_in``: tile-subset launch for the pipelined group
+    schedule, exactly as on `ops.pallas_leapfrog.fused_leapfrog_steps`
+    (``T`` is a plain input, not part of the carry).
     """
     n0, n1, n2 = Pf.shape
     if T.shape != Pf.shape:
@@ -207,30 +212,47 @@ def fused_pt_iterations(T, Pf, qxp, qyp, qzp, k: int,
         bx, by = default_tile(
             (n0, n1, n2), k, Pf.dtype.itemsize, zpatch=zp, zexport=z_export
         )
+    carry_in = _envelope.check_tile_subset(
+        tile_sel, carry_in, (n0, n1), (bx, by), nouts=7 if z_export else 4
+    )
+    from ..utils.compat import pallas_interpret_active
+
     fn = _build(n0, n1, n2, str(Pf.dtype), int(k),
                 float(th), float(idx), float(idy), float(idz),
                 float(ralam), float(bp), int(bx), int(by), zp,
                 bool(z_export), int(z_overlap) if z_export else 0,
-                wp if zp else 0, we if z_export else 0)
-    if zp:
-        return fn(T, Pf, qxp, qyp, qzp, *z_patches)
-    return fn(T, Pf, qxp, qyp, qzp)
+                wp if zp else 0, we if z_export else 0,
+                str(tile_sel), carry_in is not None,
+                pallas_interpret_active())
+    args = (T, Pf, qxp, qyp, qzp) + (tuple(z_patches) if zp else ())
+    if carry_in is not None:
+        args += tuple(carry_in)
+    return fn(*args)
 
 
 @functools.lru_cache(maxsize=64)
 def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
            zp: bool = False, zx: bool = False, o: int = 0,
-           wp: int = 0, we: int = 0):
+           wp: int = 0, we: int = 0,
+           tile_sel: str = "all", carry: bool = False, interp: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from ..utils.compat import pallas_compiler_params
+    from .overlap import tile_subset_count, tile_subset_map
 
     H = _envelope.aligned_halo(k)
     SX, SY = bx + 2 * k, by + 2 * H
     SZ = n2
     ncx, ncy = n0 // bx, n1 // by
     ntiles = ncx * ncy
+    # Tile-subset launch (see ops/pallas_stencil.py); fix-up DMAs belong to
+    # the ring pass, like the leapfrog kernel.
+    nrun = tile_subset_count(tile_sel, ncx, ncy)
+    t_of = tile_subset_map(tile_sel, ncx, ncy)
+    fixup = not tile_sel.startswith("mid")
     dt_ = jnp.dtype(dtype)
 
     def sx_of(ix):
@@ -303,15 +325,16 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
 
     def kernel(*refs):
         ZXcz = ZXx = ZXy = None
-        if zp and zx:
-            (Tin, Pfin, Qxin, Qyin, Qzin, ZPcz, ZPx, ZPy,
-             Pfout, Qxout, Qyout, Qzout, ZXcz, ZXx, ZXy) = refs
-        elif zp:
-            (Tin, Pfin, Qxin, Qyin, Qzin, ZPcz, ZPx, ZPy,
-             Pfout, Qxout, Qyout, Qzout) = refs
+        Tin, Pfin, Qxin, Qyin, Qzin = refs[:5]
+        ZPcz, ZPx, ZPy = refs[5:8] if zp else (None, None, None)
+        nin = 8 if zp else 5
+        # A carry launch receives the ring pass's outputs as aliased inputs
+        # between the real inputs and the outputs; never read here.
+        outs = refs[nin + ((7 if zx else 4) if carry else 0):]
+        if zx:
+            Pfout, Qxout, Qyout, Qzout, ZXcz, ZXx, ZXy = outs
         else:
-            Tin, Pfin, Qxin, Qyin, Qzin, Pfout, Qxout, Qyout, Qzout = refs
-            ZPcz = ZPx = ZPy = None
+            Pfout, Qxout, Qyout, Qzout = outs
 
         def body(t, p, qx, qy, qz, sp, sqx, sqy, sqz,
                  t_is, p_is, qx_is, qy_is, qz_is,
@@ -435,21 +458,23 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                 Qyout.at[pl.ds(0, n0), pl.ds(n1, 8)],
                 fix_s.at[1],
             )
-            fix_qx.start()
-            fix_qy.start()
-            start_in(0, 0)
+            if fixup:
+                fix_qx.start()
+                fix_qy.start()
+            start_in(t_of(0), 0)
 
-            def tile(tt, _):
-                slot = jax.lax.rem(tt, 2)
+            def tile(i, _):
+                tt = t_of(i)
+                slot = jax.lax.rem(i, 2)
                 nslot = 1 - slot
 
-                @pl.when(tt + 1 < ntiles)
+                @pl.when(i + 1 < nrun)
                 def _():
-                    @pl.when(tt >= 1)
+                    @pl.when(i >= 1)
                     def _():
-                        wait_out(tt - 1, nslot)
+                        wait_out(t_of(i - 1), nslot)
 
-                    start_in(tt + 1, nslot)
+                    start_in(t_of(i + 1), nslot)
 
                 wait_in(tt, slot)
                 if zp:
@@ -509,11 +534,12 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
                 start_out(tt, slot)
                 return 0
 
-            jax.lax.fori_loop(0, ntiles, tile, 0)
-            wait_out(ntiles - 2, (ntiles - 2) % 2)
-            wait_out(ntiles - 1, (ntiles - 1) % 2)
-            fix_qx.wait()
-            fix_qy.wait()
+            jax.lax.fori_loop(0, nrun, tile, 0)
+            wait_out(t_of(nrun - 2), (nrun - 2) % 2)
+            wait_out(t_of(nrun - 1), (nrun - 1) % 2)
+            if fixup:
+                fix_qx.wait()
+                fix_qy.wait()
 
         scopes = dict(
             t=pltpu.VMEM((2, SX, SY, SZ), dt_),
@@ -563,12 +589,19 @@ def _build(n0, n1, n2, dtype, k, th, idx, idy, idz, ralam, bp, bx, by,
         out_shape += [
             jax.ShapeDtypeStruct(s, dt_) for s in z_patch_shapes((n0, n1, n2))
         ]
+    nbase = 8 if zp else 5
+    nouts = len(out_shape)
     call = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (8 if zp else 5),
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * len(out_shape),
-        compiler_params=pltpu.CompilerParams(
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)]
+        * (nbase + (nouts if carry else 0)),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nouts,
+        input_output_aliases=(
+            {nbase + j: j for j in range(nouts)} if carry else {}
+        ),
+        interpret=interp,
+        compiler_params=pallas_compiler_params(
             vmem_limit_bytes=_envelope.vmem_limit(vmem_bytes)
         ),
     )

@@ -185,8 +185,16 @@ def zpatch_transposed(shape, k: int, itemsize: int = 4,
     """Whether the z-patch cadence for this config uses the TRANSPOSED
     thin-patch layout (full-y tiles) — the model cadence must build and
     communicate patches in the matching layout (`ops.halo` ``*_t``
-    helpers vs the packed 128-lane ones)."""
-    if bx is None and by is None:
+    helpers vs the packed 128-lane ones).
+
+    Default-tile resolution mirrors the kernel's ``bx is None or by is
+    None`` handling (ADVICE r5 low #4): a partially-specified tile resolves
+    through the same ladder the kernel would use rather than trusting the
+    lone ``by`` — otherwise a ``by=None``-only call could report one patch
+    layout while `fused_diffusion_steps` (which rejects half tiles and, in
+    the model's auto path, runs the ladder default) uses the other.
+    """
+    if bx is None or by is None:
         t = default_tile(shape, k, itemsize, zpatch=True, zexport=zexport)
         if t is None:
             return False
@@ -233,7 +241,8 @@ def fused_support_error(shape, k: int, itemsize: int = 4,
 def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
                           *, bx: int | None = None, by: int | None = None,
                           z_patch=None, z_export: bool = False,
-                          z_overlap: int | None = None):
+                          z_overlap: int | None = None,
+                          tile_sel: str = "all", carry_in=None):
     """Advance ``k`` (even) diffusion steps in one HBM pass.
 
     ``cx = dt*lam/dx^2`` (likewise ``cy``, ``cz``); ``(bx, by)`` = output
@@ -260,6 +269,17 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
     (minor-dim lane-unaligned slices — the z-anisotropy gap,
     docs/performance.md).  `ops.halo.z_patch_from_export` turns the export
     into the next patch.
+
+    ``tile_sel`` (pipelined group schedule, `ops.overlap.tile_subset_map`):
+    restrict the launch to a tile subset — ``"ring*"`` = the boundary tiles
+    (owning the x/y slab-exchange send planes), ``"mid*"`` = the interior
+    bulk.  A ``"mid*"`` launch requires ``carry_in``: the matching
+    ``"ring*"`` launch's output array(s), aliased into this launch's
+    outputs so the combined result needs no extra copy (the interior pass
+    writes only its tiles' owned blocks; the boundary blocks ride the
+    alias).  The split must be admissible (`ops.overlap.tile_split_error`);
+    subset launches skip no per-tile work, so ring+mid is tile-for-tile
+    identical to one ``"all"`` launch.
     """
     n0, n1, n2 = T.shape
     if T.dtype != Cp.dtype:
@@ -299,21 +319,34 @@ def fused_diffusion_steps(T, Cp, k: int, cx: float, cy: float, cz: float,
                 f"z_patch must have shape {want} for tile ({bx},{by}): got "
                 f"{tuple(z_patch.shape)}"
             )
+    carry_in = _envelope.check_tile_subset(
+        tile_sel, carry_in, (n0, n1), (bx, by),
+        nouts=2 if z_export else 1,
+    )
+    from ..utils.compat import pallas_interpret_active
+
     fn = _build(n0, n1, n2, str(T.dtype), int(k),
                 float(cx), float(cy), float(cz), int(bx), int(by), zp,
-                bool(z_export), int(z_overlap) if z_export else 0)
-    if zp:
-        return fn(T, Cp, z_patch)
-    return fn(T, Cp)
+                bool(z_export), int(z_overlap) if z_export else 0,
+                str(tile_sel), carry_in is not None,
+                pallas_interpret_active())
+    args = (T, Cp, z_patch) if zp else (T, Cp)
+    if carry_in is not None:
+        args += tuple(carry_in)
+    return fn(*args)
 
 
 @functools.lru_cache(maxsize=64)
 def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
-           zx: bool = False, o: int = 0):
+           zx: bool = False, o: int = 0, tile_sel: str = "all",
+           carry: bool = False, interp: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    from ..utils.compat import pallas_compiler_params
+    from .overlap import tile_subset_count, tile_subset_map
 
     # Full-y mode (by == n1): the window spans all of y with no y halo (the
     # window edge IS the block edge, where the frozen ring reproduces the
@@ -372,16 +405,27 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
         dst[1:-1, 1:-1, 1:-1] = s[1:-1, 1:-1, 1:-1] + lap * minv[1:-1, 1:-1, 1:-1]
 
     ntiles = ncx * ncy
+    # Tile-subset launch (pipelined group schedule): the loop runs over the
+    # subset's index space and `t_of` maps it onto flat tile indices — the
+    # per-tile work is identical to an "all" launch, only WHICH tiles run
+    # changes.  `t_of` is pure arithmetic, so the drain below can evaluate
+    # it on Python ints for the static last-two indices.
+    nrun = tile_subset_count(tile_sel, ncx, ncy)
+    t_of = tile_subset_map(tile_sel, ncx, ncy)
 
     def kernel(*refs):
         ZXout = None
-        if zp and zx:
-            Tin, Cpin, ZPin, Tout, ZXout = refs
-        elif zp:
-            Tin, Cpin, ZPin, Tout = refs
+        nin = 3 if zp else 2
+        Tin, Cpin = refs[0], refs[1]
+        ZPin = refs[2] if zp else None
+        # A carry launch receives the ring pass's outputs as aliased inputs
+        # between the real inputs and the outputs; the kernel never reads
+        # them (the alias itself carries their bytes into the outputs).
+        outs = refs[nin + ((2 if zx else 1) if carry else 0):]
+        if zx:
+            Tout, ZXout = outs
         else:
-            Tin, Cpin, Tout = refs
-            ZPin = None
+            (Tout,) = outs
 
         def body(tin, cpin, scratch, in_sems, cp_sems, out_sems,
                  zpin=None, zp_sems=None, zex=None, zex_sems=None):
@@ -445,31 +489,35 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
                     zex_sems.at[slot],
                 )
 
-            in_dma(0, 0).start()
-            cp_dma(0, 0).start()
+            in_dma(t_of(0), 0).start()
+            cp_dma(t_of(0), 0).start()
             if zp:
-                zp_dma(0, 0).start()
+                zp_dma(t_of(0), 0).start()
 
-            def tile(t, _):
-                slot = jax.lax.rem(t, 2)
+            def tile(i, _):
+                # i runs over the launch's subset; t is the flat tile index
+                # (identical for "all" launches).  Slot parity follows i so
+                # consecutive subset tiles always double-buffer.
+                t = t_of(i)
+                slot = jax.lax.rem(i, 2)
                 nslot = 1 - slot
 
-                @pl.when(t + 1 < ntiles)
+                @pl.when(i + 1 < nrun)
                 def _():
-                    @pl.when(t >= 1)
+                    @pl.when(i >= 1)
                     def _():
-                        # nslot still holds tile t-1's output; fence the
-                        # out-DMA (and the z-export DMA, whose staging slot
-                        # is rewritten at tile t+1's compute) before
-                        # prefetching into it.
-                        out_dma(t - 1, nslot).wait()
+                        # nslot still holds the previous tile's output;
+                        # fence the out-DMA (and the z-export DMA, whose
+                        # staging slot is rewritten at the next tile's
+                        # compute) before prefetching into it.
+                        out_dma(t_of(i - 1), nslot).wait()
                         if zx:
-                            zex_dma(t - 1, nslot).wait()
+                            zex_dma(t_of(i - 1), nslot).wait()
 
-                    in_dma(t + 1, nslot).start()
-                    cp_dma(t + 1, nslot).start()
+                    in_dma(t_of(i + 1), nslot).start()
+                    cp_dma(t_of(i + 1), nslot).start()
                     if zp:
-                        zp_dma(t + 1, nslot).start()
+                        zp_dma(t_of(i + 1), nslot).start()
 
                 in_dma(t, slot).wait()
                 cp_dma(t, slot).wait()
@@ -534,14 +582,14 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
                 out_dma(t, slot).start()
                 return 0
 
-            jax.lax.fori_loop(0, ntiles, tile, 0)
-            # Drain the two in-flight out-DMAs (ntiles >= 2 by validation,
-            # and they use distinct slots).
-            out_dma(ntiles - 2, (ntiles - 2) % 2).wait()
-            out_dma(ntiles - 1, (ntiles - 1) % 2).wait()
+            jax.lax.fori_loop(0, nrun, tile, 0)
+            # Drain the two in-flight out-DMAs (every launch runs >= 2
+            # tiles by validation, and they use distinct slots).
+            out_dma(t_of(nrun - 2), (nrun - 2) % 2).wait()
+            out_dma(t_of(nrun - 1), (nrun - 1) % 2).wait()
             if zx:
-                zex_dma(ntiles - 2, (ntiles - 2) % 2).wait()
-                zex_dma(ntiles - 1, (ntiles - 1) % 2).wait()
+                zex_dma(t_of(nrun - 2), (nrun - 2) % 2).wait()
+                zex_dma(t_of(nrun - 1), (nrun - 1) % 2).wait()
 
         scopes = dict(
             tin=pltpu.VMEM((2, SX, SY, n2), dt_),
@@ -577,15 +625,22 @@ def _build(n0, n1, n2, dtype, k, cx, cy, cz, bx, by, zp: bool = False,
             out_shape,
             jax.ShapeDtypeStruct((n0, PE, n1p) if zt else (n0, n1, 128), dt_),
         )
+    nouts = 2 if zx else 1
+    nin = (3 if zp else 2) + (nouts if carry else 0)
+    aliases = {3 if zp else 2: 0}
+    if carry and zx:
+        aliases[(3 if zp else 2) + 1] = 1
     call = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (3 if zp else 2),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * nin,
         out_specs=(
             [pl.BlockSpec(memory_space=pl.ANY)] * 2
             if zx else pl.BlockSpec(memory_space=pl.ANY)
         ),
-        compiler_params=pltpu.CompilerParams(
+        input_output_aliases=aliases if carry else {},
+        interpret=interp,
+        compiler_params=pallas_compiler_params(
             vmem_limit_bytes=_envelope.vmem_limit(2 * vmem_bytes)
         ),
     )

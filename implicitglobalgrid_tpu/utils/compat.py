@@ -11,6 +11,61 @@ follows.
 
 from __future__ import annotations
 
+import contextlib
+
+#: Module flag behind `pallas_force_interpret` on JAX versions without the
+#: TPU interpreter (`pltpu.force_tpu_interpret_mode`): the repo's kernels
+#: read it (via `pallas_interpret_active`) and pass ``interpret=True`` to
+#: `pallas_call`, routing through the generic Pallas interpreter instead.
+_pallas_interpret = False
+
+
+def pallas_compiler_params(**kwargs):
+    """`pltpu.CompilerParams` across JAX versions (older: `TPUCompilerParams`).
+
+    Both spell the same Mosaic knobs (``vmem_limit_bytes`` et al.); only the
+    class name moved.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+@contextlib.contextmanager
+def pallas_force_interpret():
+    """Run Pallas TPU kernels in interpret mode, across JAX versions.
+
+    Newer JAX: delegates to ``pltpu.force_tpu_interpret_mode()`` (the
+    TPU-semantics interpreter).  Older JAX (no such API): flips a module
+    flag that the repo's kernel builders consult to pass ``interpret=True``
+    to `pallas_call` — the generic interpreter, which executes this repo's
+    DMA/`run_scoped` kernel style correctly (validated against the XLA
+    cadences by the kernel test suites).  Note the flag is part of each
+    builder's cache key, so interpret and compiled executables never mix.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    global _pallas_interpret
+    force = getattr(pltpu, "force_tpu_interpret_mode", None)
+    if force is not None:
+        with force():
+            yield
+        return
+    prev = _pallas_interpret
+    _pallas_interpret = True
+    try:
+        yield
+    finally:
+        _pallas_interpret = prev
+
+
+def pallas_interpret_active() -> bool:
+    """Whether `pallas_force_interpret`'s flag-based fallback is active."""
+    return _pallas_interpret
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """`jax.shard_map` across JAX versions.

@@ -63,6 +63,8 @@ def _op_kind(rest: str) -> str:
         return "collective-permute"
     if re.search(r"\bfusion\(", rest):
         return "fusion"
+    if re.search(r"\bcustom-call\(", rest):
+        return "custom-call"
     return "other"
 
 
@@ -78,10 +80,21 @@ def collective_payloads(txt: str) -> list[dict]:
     """Per-hop payloads of every collective-permute in an optimized program.
 
     Returns one record per ``collective-permute``/``collective-permute-start``
-    instruction: ``{"shape", "dtype", "bytes"}`` — the first array type ahead
-    of the op kind is the moved buffer (for async starts the output tuple's
-    leading array).  The per-hop *byte* count is what a weak-scaling budget
-    needs: payload ÷ link bandwidth + hop latency vs the measured step time.
+    instruction: ``{"shape", "dtype", "bytes"}`` — every non-scalar array in
+    the instruction's (possibly tuple) type is payload (a combined /
+    multi-operand permute moves all of them in one hop; scalars are the
+    async-start ops' u32 context, not payload).  The per-hop *byte* count is
+    what a weak-scaling budget needs: payload ÷ link bandwidth + hop latency
+    vs the measured step time.
+
+    Async-start tuples list each moved buffer TWICE — ``(aliased
+    operands..., results..., contexts...)`` — so the RESULT half is counted,
+    verified by matching the two halves elementwise (ADVICE r5 low #3: the
+    old blind ``total //= 2`` could silently skew the budget's per-hop
+    bytes on any tuple shape drift).  A start op whose array list does not
+    split into two identical halves falls back to the raw sum and flags it
+    (``"payload_fallback": "raw-sum"``) so a budget consumer can see the
+    number is an upper bound, not silently half-wrong.
     """
     out = []
     for lines in parse_computations(txt).values():
@@ -94,34 +107,102 @@ def collective_payloads(txt: str) -> list[dict]:
             if kind not in ("collective-permute", "collective-permute-start"):
                 continue
             head = rest.split("collective-permute")[0]
-            # Sum every non-scalar array in the (possibly tuple) type: a
-            # combined / multi-operand permute moves all of them in one hop
-            # (scalars are the async-start ops' u32 context, not payload).
-            # Async starts list each buffer TWICE — the tuple is (aliased
-            # operands..., results..., contexts...) — so halve their sum
-            # (verified against a compiled program's instruction).
-            shapes, total = [], 0
+            arrays = []  # (type string, bytes) per non-scalar array
             for dt, shp in _ARRAY_RE.findall(head):
                 if not shp:
                     continue
                 elems = 1
                 for x in shp.split(","):
                     elems *= int(x)
-                shapes.append(f"{dt}[{shp}]")
-                total += elems * _DTYPE_BYTES[dt]
-            if not shapes:
+                arrays.append((f"{dt}[{shp}]", elems * _DTYPE_BYTES[dt]))
+            if not arrays:
                 continue
+            fallback = None
             if kind == "collective-permute-start":
-                total //= 2
-                shapes = shapes[: max(len(shapes) // 2, 1)]
-            out.append(
-                {
-                    "shape": ",".join(shapes),
-                    "dtype": shapes[0].split("[")[0],
-                    "bytes": total,
-                }
-            )
+                half = len(arrays) // 2
+                if len(arrays) % 2 == 0 and arrays[:half] == arrays[half:]:
+                    arrays = arrays[half:]  # the results half
+                else:
+                    fallback = "raw-sum"
+            rec = {
+                "shape": ",".join(a[0] for a in arrays),
+                "dtype": arrays[0][0].split("[")[0],
+                "bytes": sum(a[1] for a in arrays),
+            }
+            if fallback:
+                rec["payload_fallback"] = fallback
+            out.append(rec)
     return out
+
+
+def pipelined_overlap_evidence(txt: str) -> dict:
+    """Structural evidence that a program schedules kernel launches across
+    its collectives — the pipelined group schedule's HLO check.
+
+    For every computation holding both collective-permutes and
+    custom-calls (the Pallas kernel launches are ``custom-call``s in the
+    optimized program), count the (collective, custom-call) pairs with NO
+    transitive dependency in either direction: XLA's scheduler is free to
+    run such a pair concurrently.  The serialized schedule has none (every
+    kernel launch feeds or consumes every group-boundary exchange); the
+    pipelined schedule's interior passes are exactly the launches built to
+    be independent of the in-flight permutes.
+
+    Returns ``{"n_collectives", "n_custom_calls", "independent_pairs",
+    "overlappable_collectives"}`` (the last: collectives with at least one
+    independent kernel launch).
+    """
+    n_cp, n_cc, pairs, overlappable = 0, 0, 0, 0
+    for lines in parse_computations(txt).values():
+        if not any("collective-permute" in l for l in lines):
+            continue
+        insts: dict[str, tuple[str, str, list[str]]] = {}
+        for l in lines:
+            m = _INST_RE.match(l)
+            if m:
+                name, rest = m.groups()
+                insts[name] = (_op_kind(rest), rest, re.findall(r"%([\w\.\-]+)", rest))
+
+        def closure(n):
+            seen: set = set()
+            stack = [n]
+            while stack:
+                for o in insts.get(stack.pop(), (None, None, []))[2]:
+                    if o not in seen:
+                        seen.add(o)
+                        stack.append(o)
+            return seen
+
+        cps = [
+            n
+            for n, (op, _, _) in insts.items()
+            if op in ("collective-permute", "collective-permute-start")
+        ]
+        ccs = [n for n, (op, _, _) in insts.items() if op == "custom-call"]
+        if not ccs:
+            continue
+        n_cp += len(cps)
+        n_cc += len(ccs)
+        cc_closures = {c: closure(c) for c in ccs}
+        # An async collective is "independent" of a launch when neither its
+        # start nor its done reaches the launch (and vice versa); dones are
+        # found as consumers via the start's name appearing in closures.
+        for cp in cps:
+            cp_clo = closure(cp)
+            free = [
+                cc
+                for cc in ccs
+                if cc not in cp_clo and cp not in cc_closures[cc]
+            ]
+            pairs += len(free)
+            if free:
+                overlappable += 1
+    return {
+        "n_collectives": n_cp,
+        "n_custom_calls": n_cc,
+        "independent_pairs": pairs,
+        "overlappable_collectives": overlappable,
+    }
 
 
 def collective_waits(txt: str, big_elems: int) -> tuple[int, list[bool], int]:

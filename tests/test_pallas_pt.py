@@ -65,11 +65,11 @@ def _xla_iters(state, params, k):
 
 
 def _fused_interpret(state, params, k, **kw):
-    from jax.experimental.pallas import tpu as pltpu
+    from implicitglobalgrid_tpu.utils.compat import pallas_force_interpret
 
     T, Pf, qDx, qDy, qDz = state
     qxp, qyp, qzp = pad_faces(qDx, qDy, qDz)
-    with pltpu.force_tpu_interpret_mode():
+    with pallas_force_interpret():
         Pf, qxp, qyp, qzp = fused_pt_iterations(
             T, Pf, qxp, qyp, qzp, k,
             params.theta_q,
