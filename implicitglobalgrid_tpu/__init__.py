@@ -69,8 +69,10 @@ from .utils.resilience import (
 )
 from .utils.checkpoint import (
     latest_checkpoint,
+    prune_checkpoints,
     restore_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
 )
 
 __version__ = "0.1.0"
@@ -124,4 +126,6 @@ __all__ = [
     "save_checkpoint",
     "restore_checkpoint",
     "latest_checkpoint",
+    "verify_checkpoint",
+    "prune_checkpoints",
 ]

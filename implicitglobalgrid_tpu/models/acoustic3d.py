@@ -629,12 +629,14 @@ def run(
     guard_policy: str | None = None,
     checkpoint_every: int | None = None,
     checkpoint_dir: str | None = None,
+    checkpoint_keep: int | None = None,
     **kw,
 ):
     """End-to-end run; returns the final global-block pressure field.
 
     Resilience hooks as in `models.diffusion3d.run` (``guard_every`` /
-    ``guard_policy`` / ``checkpoint_every`` / ``checkpoint_dir``)."""
+    ``guard_policy`` / ``checkpoint_every`` / ``checkpoint_dir`` /
+    ``checkpoint_keep``; resume is topology-elastic)."""
     import jax
 
     from ..parallel.grid import global_grid
@@ -651,6 +653,7 @@ def run(
             policy=guard_policy,
             checkpoint_every=checkpoint_every,
             checkpoint_dir=checkpoint_dir,
+            checkpoint_keep=checkpoint_keep,
             names=("P", "Vx", "Vy", "Vz"),
         )
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"

@@ -644,6 +644,7 @@ def run(
     guard_policy: str | None = None,
     checkpoint_every: int | None = None,
     checkpoint_dir: str | None = None,
+    checkpoint_keep: int | None = None,
     **setup_kwargs,
 ):
     """End-to-end run (the reference's ``diffusion3D()`` without visualization).
@@ -655,7 +656,11 @@ def run(
     steps under ``guard_policy`` (``raise`` | ``warn`` | ``rollback``);
     ``checkpoint_every=N`` writes restartable checkpoints to
     ``checkpoint_dir`` — a rerun pointing at the same directory resumes
-    from the latest one.
+    from the latest VALID one, even on a different admissible topology
+    (elastic restart: re-init with any ``dims``/local sizes implying the
+    same global grid).  ``checkpoint_keep=N`` (``IGG_CHECKPOINT_KEEP``)
+    prunes to the newest N generations after each save, never deleting the
+    only integrity-verified one.
     """
     import jax
 
@@ -671,6 +676,7 @@ def run(
             policy=guard_policy,
             checkpoint_every=checkpoint_every,
             checkpoint_dir=checkpoint_dir,
+            checkpoint_keep=checkpoint_keep,
             names=("T", "Cp"),
         )
         # On the virtual CPU mesh, XLA's in-process collectives deadlock if

@@ -24,10 +24,13 @@ already* the block-ordered global array (one block per device), so:
   EVERY process, which at pod scale (512^3 f32 x 256 chips ~ 137 GB) OOMs
   every host; this path replaces it.
 
-Like the reference, no halo de-duplication is performed — the result is the
-blocks side by side; strip halos first with `block_slice` if needed
-(the reference's examples do exactly that on the caller side,
-`/root/reference/examples/diffusion3D_multigpu_CuArrays.jl:53-54`).
+Like the reference, no halo de-duplication is performed by default — the
+result is the blocks side by side; strip halos first with `block_slice` if
+needed (the reference's examples do exactly that on the caller side,
+`/root/reference/examples/diffusion3D_multigpu_CuArrays.jl:53-54`), or pass
+``dedup=True`` for the owner-wise de-duplicated ``nxyz_g`` view
+(`assemble_dedup` — the same block-assembly rule the elastic checkpoint
+restore reshards with).
 """
 
 from __future__ import annotations
@@ -141,7 +144,7 @@ def _gather_batch_size() -> int:
     return max(int(val), 1) if val is not None else 8
 
 
-def _gather_chunked(A, gg, out: np.ndarray | None):
+def _gather_chunked(A, gg, out: np.ndarray | None, dedup: bool = False):
     """Batched block-by-block multi-host assembly (root-only memory bound).
 
     Collective: every process iterates the same batch sequence (the
@@ -182,10 +185,21 @@ def _gather_chunked(A, gg, out: np.ndarray | None):
         jax.block_until_ready(blk)
         if out is not None:  # the root, assembling (see `gather`)
             data = np.asarray(blk.addressable_shards[0].data)
-            for j, idx in enumerate(chunk):
-                out[
-                    tuple(slice(c * b, (c + 1) * b) for c, b in zip(idx, bshape))
-                ] = data[j]
+            if dedup:
+                assemble_dedup(
+                    {idx: data[j] for j, idx in enumerate(chunk)},
+                    bshape,
+                    dims,
+                    _field_ols(gg, bshape),
+                    gg.periods[:ndim],
+                    data.dtype,
+                    out=out,
+                )
+            else:
+                for j, idx in enumerate(chunk):
+                    out[
+                        tuple(slice(c * b, (c + 1) * b) for c, b in zip(idx, bshape))
+                    ] = data[j]
             host_bytes += data.nbytes
             del data
         del blk
@@ -207,13 +221,130 @@ def _local_shape(A, gg):
     return local_shape(A, gg)
 
 
-def gather(A, A_global=None, *, root: int = 0, _force_chunked: bool = False):
+# -- De-duplicated (owner-wise) block assembly --------------------------------
+#
+# The global-block representation stores overlap cells redundantly (blocks
+# side by side, like the reference's per-process local arrays); these helpers
+# assemble the DE-DUPLICATED global grid from per-block arrays by giving each
+# global cell to exactly one owning block.  Shared by `gather(dedup=True)`
+# and the elastic checkpoint restore (`utils.checkpoint.restore_checkpoint`
+# resharding a checkpoint onto a different topology) — one ownership rule,
+# so the two paths cannot disagree about which copy of an overlap cell wins.
+
+
+def owned_range(c: int, nblocks: int, size: int, ol: int, periodic: bool) -> tuple[int, int]:
+    """Local index range ``[a, b)`` of the cells block ``c`` owns in one dim.
+
+    Adjacent blocks share ``ol`` overlap cells; the midpoint split gives the
+    first ``ceil(ol/2)`` to the left block and the rest to the right one —
+    the partition that keeps every owned cell as deep inside its block as
+    possible (most robust choice when outer halo planes are the stalest
+    data in a deep-halo schedule).  Grid-edge cells of a non-periodic dim
+    belong to the edge block whole; under periodicity every block has both
+    neighbors, and the wrap seam follows the same midpoint rule.
+    """
+    if ol < 0:
+        raise ValueError(
+            f"owned_range: negative overlap {ol} — blocks would leave gaps; "
+            f"this field does not follow the halo size convention."
+        )
+    a = 0 if (c == 0 and not periodic) else ol - ol // 2
+    b = size if (c == nblocks - 1 and not periodic) else size - ol // 2
+    return a, b
+
+
+def dedup_length(nblocks: int, size: int, ol: int, periodic: bool) -> int:
+    """De-duplicated global extent of one dim: ``nblocks*(size-ol)`` plus the
+    boundary overlap when the dim is not periodic (the nxyz_g formula,
+    applied to an arbitrary per-field local ``size``)."""
+    return nblocks * (size - ol) + (0 if periodic else ol)
+
+
+def dedup_indices(c: int, lo: int, hi: int, size: int, ol: int, glen: int) -> np.ndarray:
+    """Global de-dup indices of block ``c``'s local cells ``[lo, hi)`` in one
+    dim.  Local cell ``j`` of block ``c`` is global cell ``(c*(size-ol) + j)
+    mod glen`` — the modulo realizes the periodic wrap (a halo cell past the
+    seam aliases the cell at the far side)."""
+    return (c * (size - ol) + np.arange(lo, hi)) % glen
+
+
+def assemble_dedup(
+    blocks, bshape, dims, ols, periods, dtype, out: np.ndarray | None = None
+) -> np.ndarray:
+    """Assemble the de-duplicated global array from ``{coords: block}``.
+
+    ``blocks`` maps Cartesian block coordinates (tuples of length ndim) to
+    per-block numpy arrays of shape ``bshape``; ``dims``/``ols``/``periods``
+    are per-dim block counts, overlaps and periodicity flags (each clipped
+    to the field's rank by the caller).  Every global cell is written from
+    its OWNING block only (`owned_range`), so stale outer halo planes can
+    never overwrite an owner's value.
+    """
+    gshape = tuple(
+        dedup_length(d, s, o, bool(p))
+        for d, s, o, p in zip(dims, bshape, ols, periods)
+    )
+    if out is None:
+        out = np.empty(gshape, dtype)
+    for coords, block in blocks.items():
+        sel = []
+        idxs = []
+        for dim, c in enumerate(coords):
+            a, b = owned_range(
+                c, dims[dim], bshape[dim], ols[dim], bool(periods[dim])
+            )
+            sel.append(slice(a, b))
+            idxs.append(
+                dedup_indices(c, a, b, bshape[dim], ols[dim], gshape[dim])
+            )
+        out[np.ix_(*idxs)] = block[tuple(sel)]
+    return out
+
+
+def _field_ols(gg, bshape) -> tuple[int, ...]:
+    """Per-dim overlap of a field with local shape ``bshape`` (shape-aware:
+    staggered ``n+1`` fields carry overlap+1, reference src/shared.jl:93)."""
+    from .halo import ol as _ol
+
+    return tuple(
+        _ol(d, shape=bshape, gg=gg) for d in range(len(bshape))
+    )
+
+
+def dedup_shape(A, gg=None) -> tuple[int, ...]:
+    """De-duplicated global shape of field ``A`` (``nxyz_g`` adjusted for the
+    field's own stagger/rank)."""
+    if gg is None:
+        gg = _grid.global_grid()
+    bshape = _local_shape(A, gg)
+    ols = _field_ols(gg, bshape)
+    return tuple(
+        dedup_length(gg.dims[d], bshape[d], ols[d], bool(gg.periods[d]))
+        for d in range(len(bshape))
+    )
+
+
+def gather(
+    A,
+    A_global=None,
+    *,
+    root: int = 0,
+    dedup: bool = False,
+    _force_chunked: bool = False,
+):
     """Gather field ``A`` to the host on process ``root``.
 
     Returns the assembled numpy array on the root process and ``None`` on all
     other processes.  If ``A_global`` (a numpy array of matching size and
     dtype) is given, it is filled in place on the root and ``None`` is
     returned — the reference's ``gather!(A, A_global)`` signature.
+
+    ``dedup=True`` returns the DE-DUPLICATED global grid (shape
+    `dedup_shape(A)`, the ``nxyz_g`` view) instead of the blocks side by
+    side: every overlap cell comes from its owning block (`owned_range`) —
+    the halo-stripping the reference's examples hand-roll caller-side, and
+    the representation in which fields from DIFFERENT topologies of the
+    same global problem are comparable (the elastic-restart oracle).
 
     Collective: on a multi-process runtime EVERY process must make this call
     (non-roots pass ``A_global=None``), exactly like the reference where
@@ -250,9 +381,18 @@ def gather(A, A_global=None, *, root: int = 0, _force_chunked: bool = False):
 
     if chunked:
         bshape = _local_shape(A, gg)
-        gshape = tuple(
-            d * b for d, b in zip(gg.dims[: A.ndim], bshape)
-        )
+        if dedup:
+            gshape = tuple(
+                dedup_length(d, b, o, bool(p))
+                for d, b, o, p in zip(
+                    gg.dims[: A.ndim],
+                    bshape,
+                    _field_ols(gg, bshape),
+                    gg.periods[: A.ndim],
+                )
+            )
+        else:
+            gshape = tuple(d * b for d, b in zip(gg.dims[: A.ndim], bshape))
         gsize = int(np.prod(gshape))
         # A root-side argument error must not strand non-roots mid-collective
         # (see docstring): on invalid A_global the root still participates in
@@ -269,7 +409,7 @@ def gather(A, A_global=None, *, root: int = 0, _force_chunked: bool = False):
                     out = A_global.reshape(gshape)
             else:
                 out = np.empty(gshape, np.dtype(A.dtype))
-        out = _gather_chunked(A, gg, out)
+        out = _gather_chunked(A, gg, out, dedup=dedup)
         if err is not None:
             raise err
         if not is_root or A_global is not None:
@@ -285,6 +425,23 @@ def gather(A, A_global=None, *, root: int = 0, _force_chunked: bool = False):
     }
     if not is_root:
         return None
+    if dedup:
+        bshape = _local_shape(A, gg)
+        dims = gg.dims[: A.ndim]
+        blocks = {
+            idx: data[
+                tuple(slice(c * b, (c + 1) * b) for c, b in zip(idx, bshape))
+            ]
+            for idx in (list(np.ndindex(*dims)) or [()])
+        }
+        data = assemble_dedup(
+            blocks,
+            bshape,
+            dims,
+            _field_ols(gg, bshape),
+            gg.periods[: A.ndim],
+            data.dtype,
+        )
     if A_global is not None:
         _check_out(A_global, data.size, data.dtype)
         np.copyto(A_global.reshape(data.shape), data)

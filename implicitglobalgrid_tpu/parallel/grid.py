@@ -91,6 +91,48 @@ class GlobalGrid:
         }
 
 
+def elastic_topology_error(saved: dict, current: dict) -> str | None:
+    """Why ``current`` cannot elastically restore a checkpoint written under
+    ``saved``, or None when it can.
+
+    Both arguments are `GlobalGrid.checkpoint_meta` dicts.  The implicit
+    global grid makes topology a *derived* quantity — any ``(nxyz, dims,
+    overlaps, periods)`` implying the same de-duplicated global size
+    (`topology.implied_global_shape`) describes the same physical grid, so a
+    checkpoint written at ``dims=(2,2,2)`` is restorable on a surviving
+    ``(2,2,1)`` or replacement ``(4,1,2)`` slice.  Periodicity must match:
+    it is part of the physical problem (and changes the de-dup identity of
+    the boundary overlap), not of the decomposition.
+    """
+    mismatches = []
+    if tuple(saved.get("periods", ())) != tuple(current.get("periods", ())):
+        mismatches.append(
+            f"periods: checkpoint {list(saved.get('periods', []))} vs "
+            f"current {list(current.get('periods', []))} (periodicity is "
+            f"part of the physical problem, not of the decomposition)"
+        )
+    saved_g = topology.implied_global_shape(
+        saved["nxyz"], saved["dims"], saved["overlaps"], saved["periods"]
+    )
+    cur_g = topology.implied_global_shape(
+        current["nxyz"], current["dims"], current["overlaps"], current["periods"]
+    )
+    if saved_g != cur_g:
+        mismatches.append(
+            f"implied global size nxyz_g = dims*(nxyz-overlaps) + "
+            f"overlaps*(periods==0): checkpoint "
+            f"{list(saved.get('nxyz_g', saved_g))} (from nxyz="
+            f"{list(saved['nxyz'])}, dims={list(saved['dims'])}, overlaps="
+            f"{list(saved['overlaps'])}) vs current {list(cur_g)} (from "
+            f"nxyz={list(current['nxyz'])}, dims={list(current['dims'])}, "
+            f"overlaps={list(current['overlaps'])}) — adjust the local "
+            f"sizes so the target topology spans the same global grid"
+        )
+    if mismatches:
+        return "; ".join(mismatches)
+    return None
+
+
 _global_grid: GlobalGrid | None = None
 _epoch = 0
 
@@ -240,8 +282,8 @@ def init_global_grid(
     coords = tuple(int(c) for c in pos[0]) if len(pos) else (0, 0, 0)
     me = topology.rank_of_coords(coords, dims)
     neighbors = topology.neighbors_table(coords, dims, periods, disp)
-    nxyz_g = tuple(
-        d * (n - o) + o * (p == 0) for n, d, o, p in zip(nxyz, dims, overlaps, periods)
+    nxyz_g = topology.implied_global_shape(
+        nxyz, dims, overlaps, periods
     )  # src/init_global_grid.jl:93
 
     _epoch += 1

@@ -80,6 +80,26 @@ def dims_create(nprocs: int, dims: tuple[int, int, int]) -> tuple[int, int, int]
     return tuple(out)
 
 
+def implied_global_shape(nxyz, dims, overlaps, periods) -> tuple[int, ...]:
+    """The implicit global grid size ``nxyz_g`` a topology defines.
+
+    The identity the whole library rests on
+    (`/root/reference/src/init_global_grid.jl:93`)::
+
+        nxyz_g = dims*(nxyz - overlaps) + overlaps*(periods == 0)
+
+    Exposed as a pure function so `init_global_grid` and the elastic
+    checkpoint restore (`utils.checkpoint`) derive the global size from ONE
+    formula: a checkpoint written under one ``(nxyz, dims, overlaps,
+    periods)`` is restorable under any other that implies the same
+    ``nxyz_g`` (`parallel.grid.elastic_topology_error`).
+    """
+    return tuple(
+        int(d) * (int(n) - int(o)) + int(o) * (int(p) == 0)
+        for n, d, o, p in zip(nxyz, dims, overlaps, periods)
+    )
+
+
 def rank_of_coords(coords, dims) -> int:
     """Row-major (C-order) rank of Cartesian coordinates, dim 0 slowest."""
     cx, cy, cz = coords

@@ -4,32 +4,56 @@ A preempted worker must not lose the simulation (ROADMAP north star: serve
 heavy production traffic — preemption is routine there).  The reference has
 no restart story at all; this module adds one that respects the implicit
 global grid's memory contract: the de-duplicated global array is NEVER
-materialized.  Each process writes only its own *local shards* (the blocks
-its devices hold, halos included) plus a small JSON of grid/topology
-metadata, and restore round-trips through `init_global_grid` — a restarted
-job that re-inits with the same ``dims`` resumes mid-simulation with
-bit-identical fields.
+materialized on the fast path.  Each process writes only its own *local
+shards* (the blocks its devices hold, halos included) plus a small JSON of
+grid/topology metadata, and restore round-trips through `init_global_grid`.
 
 On-disk layout (one directory per checkpointed step)::
 
     <dir>/step_00000012/
         shards_p0.npz      per-process: raw shard bytes + global offsets
         shards_p1.npz
-        meta.json          written LAST by process 0 after a barrier —
-                           its presence marks the checkpoint complete
+        meta.json          manifest: grid topology, per-shard CRC32s/sizes;
+                           written LAST inside a hidden temp directory that
+                           is atomically renamed to step_* once complete
 
 Shard payloads are stored as raw bytes + dtype string, so every JAX dtype
 (incl. ``bfloat16`` and other ``ml_dtypes`` extensions NumPy cannot
-serialize natively) round-trips bit-exactly.  A crash mid-save leaves a
-directory without ``meta.json``, which `latest_checkpoint` ignores — the
-previous complete checkpoint stays authoritative.
+serialize natively) round-trips bit-exactly.
+
+Integrity (format 2): the whole step directory is staged under a hidden
+``.step_*.tmp`` name and only renamed into place after every shard file and
+the manifest are on disk — a crash mid-save never leaves a visible
+``step_*`` directory at all.  The manifest carries per-shard CRC32s and
+byte counts; `verify_checkpoint` replays them, and `latest_checkpoint`
+falls back generation by generation to the newest checkpoint that passes —
+a torn or bit-flipped shard is detected and *skipped*, never restored into
+a silently wrong run.  Format-1 directories (pre-manifest) stay readable:
+their completion marker is the presence of ``meta.json``.
+
+Elastic restore: the global grid is *implicit* — any ``(nxyz, dims,
+overlaps, periods)`` implying the same ``nxyz_g`` describes the same
+physical grid (`parallel.topology.implied_global_shape`) — so
+`restore_checkpoint` accepts any admissible target topology: when the
+current grid differs from the save (different ``dims``, process count, or
+device-to-process layout), each field's de-duplicated global array is
+reassembled from the saved per-block offsets (`ops.gather.assemble_dedup`,
+the same owner-wise rule `gather(dedup=True)` uses) and re-sliced under the
+current grid's sharding.  ``strict=True`` preserves the bit-exact
+same-topology-only contract.  The elastic path materializes one field's
+global array at a time on each process and needs every shard file readable
+(a shared checkpoint directory); the same-topology fast path keeps the
+per-process-shards-only memory bound.
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import json
 import os
 import shutil
+import sys
+import zlib
 from typing import Any, Sequence
 
 import numpy as np
@@ -37,12 +61,20 @@ import numpy as np
 from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+#: formats this build can restore (1 = pre-manifest, no integrity data)
+READABLE_FORMATS = (1, 2)
 _META = "meta.json"
 
 
 def _step_dirname(step: int) -> str:
     return f"step_{step:08d}"
+
+
+def _tmp_dirname(step: int) -> str:
+    # Dot-prefixed: never matches the `step_*` scan, so a crash mid-save
+    # cannot leave a visible half-written generation.
+    return f".{_step_dirname(step)}.tmp"
 
 
 def _dtype_to_str(dt) -> str:
@@ -72,8 +104,24 @@ def _index_starts(index, shape) -> tuple[int, ...]:
     )
 
 
-#: keys of `GlobalGrid.checkpoint_meta` a restore must match (device_type is
-#: informational: restoring a CPU-written checkpoint on TPU is legitimate).
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(buf, crc)
+
+
+def _shard_name(pid: int) -> str:
+    return f"shards_p{pid}.npz"
+
+
+#: keys of `GlobalGrid.checkpoint_meta` the same-topology fast path must
+#: match (device_type is informational: restoring a CPU-written checkpoint
+#: on TPU is legitimate).  An elastic restore only needs admissibility
+#: (`parallel.grid.elastic_topology_error`).
 _MATCH_KEYS = ("dims", "nxyz", "nxyz_g", "overlaps", "periods", "disp", "nprocs")
 
 
@@ -87,7 +135,9 @@ def save_checkpoint(
     """Write a checkpoint of ``state`` (a sequence of global-block arrays).
 
     Collective: every process must call it (each writes its own shards; a
-    barrier orders the completion marker after all shard files).  Returns
+    barrier orders the manifest after all shard files; the staged directory
+    is atomically renamed into place by process 0, and a second barrier
+    guarantees the returned path is published on every process).  Returns
     the step directory path.  Memory-scalable: only local shards touch the
     host, never the assembled global array.
     """
@@ -103,15 +153,10 @@ def save_checkpoint(
         raise ValueError(f"step must be >= 0 (got {step})")
 
     pid = jax.process_index()
-    step_dir = os.path.join(os.fspath(directory), _step_dirname(step))
-    os.makedirs(step_dir, exist_ok=True)
-    # A complete marker from a previous visit to this step (rollback, rerun)
-    # must not vouch for the shards we are about to replace.
-    if pid == 0:
-        try:
-            os.remove(os.path.join(step_dir, _META))
-        except FileNotFoundError:
-            pass
+    directory = os.fspath(directory)
+    step_dir = os.path.join(directory, _step_dirname(step))
+    tmp_dir = os.path.join(directory, _tmp_dirname(step))
+    os.makedirs(tmp_dir, exist_ok=True)
 
     payload: dict[str, np.ndarray] = {}
     fields_meta = []
@@ -134,24 +179,48 @@ def save_checkpoint(
             if starts in seen:
                 continue  # replicated field: one copy of the block is enough
             seen.add(starts)
-            data = np.asarray(shard.data)
+            data = np.ascontiguousarray(np.asarray(shard.data))
             key = "f%d_o%s" % (i, "_".join(map(str, starts)))
-            payload[key] = np.frombuffer(
-                np.ascontiguousarray(data).tobytes(), dtype=np.uint8
-            )
+            # zero-copy byte view (a .tobytes() round-trip would double the
+            # transient host memory per shard at pod-scale sizes)
+            payload[key] = data.view(np.uint8).reshape(-1)
             payload[key + "_shape"] = np.asarray(data.shape, dtype=np.int64)
 
-    shard_path = os.path.join(step_dir, f"shards_p{pid}.npz")
+    shard_path = os.path.join(tmp_dir, _shard_name(pid))
     tmp = shard_path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
     os.replace(tmp, shard_path)
+    # Sidecar: how process 0 learns every shard's integrity record without a
+    # data collective (the checkpoint directory is the shared medium).
+    sidecar = {
+        "file": _shard_name(pid),
+        "bytes": os.path.getsize(shard_path),
+        "crc32": _crc32_file(shard_path),
+    }
+    tmp = shard_path + ".crc.json.tmp"
+    with open(tmp, "w") as f:
+        json.dump(sidecar, f)
+    os.replace(tmp, shard_path + ".crc.json")
 
-    # All shard files on disk before the completion marker exists.
+    # All shard files + sidecars on disk before the manifest is assembled.
     from ..parallel import distributed as _dist
 
     _dist.sync_all_processes()
     if pid == 0:
+        shards: dict[str, dict] = {}
+        for p in range(jax.process_count()):
+            sc_path = os.path.join(tmp_dir, _shard_name(p) + ".crc.json")
+            try:
+                with open(sc_path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError) as e:
+                raise RuntimeError(
+                    f"save_checkpoint: process {p}'s integrity sidecar "
+                    f"{sc_path} is unreadable after the barrier ({e!r}); is "
+                    f"the checkpoint directory shared by all processes?"
+                )
+            shards[rec["file"]] = {"bytes": rec["bytes"], "crc32": rec["crc32"]}
         meta = {
             "format": FORMAT_VERSION,
             "step": step,
@@ -159,25 +228,45 @@ def save_checkpoint(
             "fields": fields_meta,
             "grid": gg.checkpoint_meta(),
             "process_count": int(jax.process_count()),
+            "shards": shards,
             "extra": extra or {},
         }
-        tmp = os.path.join(step_dir, _META + ".tmp")
+        tmp = os.path.join(tmp_dir, _META + ".tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
-        os.replace(tmp, os.path.join(step_dir, _META))
+        os.replace(tmp, os.path.join(tmp_dir, _META))
+        for sc in _glob.glob(os.path.join(tmp_dir, "*.crc.json")):
+            try:
+                os.remove(sc)
+            except OSError:
+                pass
+        # Atomic publish: the complete staged directory takes the step name
+        # in one rename; a pre-existing generation of the same step (a
+        # rolled-back rerun) is replaced.
+        shutil.rmtree(step_dir, ignore_errors=True)
+        os.rename(tmp_dir, step_dir)
+        # In-tree fault injection (``ckpt_corrupt``/``ckpt_truncate``):
+        # damage the published generation AFTER the manifest vouched for it,
+        # so the integrity fallback is provable end to end.
+        from . import resilience as _res
+
+        _res.get_fault_injector().maybe_damage_checkpoint(step_dir, step)
+    # Second barrier: the returned path must exist (published) on EVERY
+    # process — without it a non-root caller could verify/restore the path
+    # before process 0's rename lands.
+    _dist.sync_all_processes()
     return step_dir
 
 
-def latest_checkpoint(directory: str | os.PathLike) -> str | None:
-    """Newest COMPLETE checkpoint directory under ``directory``, or None.
-
-    Completeness = ``meta.json`` present (written last, after the barrier);
-    directories a crash left half-written are skipped.
-    """
+def checkpoint_steps(directory: str | os.PathLike) -> list[tuple[int, str]]:
+    """All published checkpoint generations under ``directory``, sorted by
+    step ascending, as ``(step, path)`` pairs.  Published = the ``step_*``
+    rename happened and ``meta.json`` is present; integrity is NOT checked
+    here (see `verify_checkpoint` / `latest_checkpoint`)."""
     directory = os.fspath(directory)
     if not os.path.isdir(directory):
-        return None
-    best: tuple[int, str] | None = None
+        return []
+    out = []
     for name in os.listdir(directory):
         if not name.startswith("step_"):
             continue
@@ -188,9 +277,79 @@ def latest_checkpoint(directory: str | os.PathLike) -> str | None:
             step = int(name[len("step_"):])
         except ValueError:
             continue
-        if best is None or step > best[0]:
-            best = (step, path)
-    return None if best is None else best[1]
+        out.append((step, path))
+    out.sort()
+    return out
+
+
+def verify_checkpoint(path: str | os.PathLike) -> str | None:
+    """Why checkpoint ``path`` fails integrity verification, or None.
+
+    Format 2: every manifest-listed shard file must exist with the recorded
+    byte count and CRC32 — detects truncation (torn write) and corruption
+    (bit flips) before a restore can propagate them.  Format 1 predates the
+    manifest: the completion marker is the only check (legacy semantics).
+    """
+    path = os.fspath(path)
+    meta_path = os.path.join(path, _META)
+    if not os.path.isfile(meta_path):
+        return f"no completion marker ({_META})"
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable {_META} ({e})"
+    fmt = meta.get("format")
+    if fmt not in READABLE_FORMATS:
+        return f"unknown checkpoint format {fmt!r} (this build reads {READABLE_FORMATS})"
+    shards = meta.get("shards")
+    if shards is None:
+        return None  # format 1: no integrity data to replay
+    for fname, rec in shards.items():
+        fpath = os.path.join(path, fname)
+        if not os.path.isfile(fpath):
+            return f"missing shard file {fname}"
+        size = os.path.getsize(fpath)
+        if size != rec["bytes"]:
+            return (
+                f"shard {fname} truncated: {size} bytes on disk vs "
+                f"{rec['bytes']} in the manifest"
+            )
+        crc = _crc32_file(fpath)
+        if crc != rec["crc32"]:
+            return (
+                f"shard {fname} corrupt: CRC32 {crc:#010x} on disk vs "
+                f"{rec['crc32']:#010x} in the manifest"
+            )
+    return None
+
+
+def latest_checkpoint(
+    directory: str | os.PathLike, *, verify: bool = True
+) -> str | None:
+    """Newest VALID checkpoint directory under ``directory``, or None.
+
+    Walks generations newest-first: a generation failing
+    `verify_checkpoint` (torn, bit-flipped, missing shards) is reported to
+    stderr and SKIPPED, falling back to the next older one — the newest
+    generation being damaged must degrade a restart by one checkpoint
+    interval, not poison it.  ``verify=False`` restores the cheap
+    marker-only scan (format-1 semantics) for callers that only need the
+    newest published path.
+    """
+    for step, path in reversed(checkpoint_steps(directory)):
+        if not verify:
+            return path
+        problem = verify_checkpoint(path)
+        if problem is None:
+            return path
+        print(
+            f"[igg.checkpoint] skipping invalid checkpoint {path}: {problem} "
+            f"(falling back to the previous generation)",
+            file=sys.stderr,
+            flush=True,
+        )
+    return None
 
 
 def checkpoint_meta(path: str | os.PathLike) -> dict:
@@ -210,59 +369,103 @@ def restore_checkpoint(
     path: str | os.PathLike,
     *,
     like: Sequence | None = None,
+    strict: bool = False,
+    verify: bool = True,
 ) -> tuple[tuple, int, dict]:
     """Restore ``(state, step, extra)`` from a checkpoint directory.
 
-    Requires an initialized grid matching the checkpoint's topology (the
-    round-trip-through-`init_global_grid` contract: re-init with the same
-    local sizes and ``dims``, then restore).  Each process reads only its
-    own shard file; arrays are rebuilt with the field constructors'
-    sharding (or ``like``'s, when given) — bit-exact for every dtype.
+    Requires an initialized grid.  When the current topology matches the
+    save exactly (dims, local sizes, overlaps, periods, process count and
+    device-to-process layout), each process reads only its own shard file —
+    bit-exact for every dtype, the per-process memory bound.  Otherwise the
+    ELASTIC path engages (unless ``strict=True``): the target topology is
+    validated admissible (same implied ``nxyz_g`` and periodicity,
+    `parallel.grid.elastic_topology_error`), each field's de-duplicated
+    global array is assembled from the saved per-block offsets and
+    re-sliced under the current grid's sharding — also bit-exact, since
+    every target cell is a byte copy of its owning saved block's cell.
+
+    ``verify=True`` (default) replays the manifest CRCs first; a damaged
+    checkpoint raises instead of restoring garbage (use `latest_checkpoint`
+    to fall back to the newest valid generation).  ``like`` supplies the
+    target arrays' shardings (and validates shapes).
     """
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P, SingleDeviceSharding
 
     _grid.check_initialized()
     gg = _grid.global_grid()
     path = os.fspath(path)
     meta = checkpoint_meta(path)
-    if meta.get("format") != FORMAT_VERSION:
+    if meta.get("format") not in READABLE_FORMATS:
         raise ValueError(
             f"Checkpoint {path!r} has format {meta.get('format')!r}; this "
-            f"build reads format {FORMAT_VERSION}."
+            f"build reads formats {READABLE_FORMATS}."
         )
+    if verify:
+        problem = verify_checkpoint(path)
+        if problem is not None:
+            raise ValueError(
+                f"Checkpoint {path!r} failed integrity verification: "
+                f"{problem}. Use latest_checkpoint() to fall back to the "
+                f"newest valid generation."
+            )
     saved_grid = meta["grid"]
     current = gg.checkpoint_meta()
     mismatch = [k for k in _MATCH_KEYS if saved_grid.get(k) != current[k]]
-    if mismatch:
-        detail = ", ".join(
-            f"{k}: checkpoint {saved_grid.get(k)} vs current {current[k]}"
-            for k in mismatch
-        )
-        raise ValueError(
-            f"Checkpoint {path!r} was written for a different grid "
-            f"topology ({detail}). Re-init the global grid with the same "
-            f"local sizes and dims to restore it."
-        )
-    if meta["process_count"] != jax.process_count():
-        raise ValueError(
-            f"Checkpoint {path!r} was written by {meta['process_count']} "
-            f"process(es) but this job runs {jax.process_count()}; restart "
-            f"with the same process count."
-        )
+    pid = jax.process_index()
+    same_procs = meta["process_count"] == jax.process_count()
+    shard_path = os.path.join(path, _shard_name(pid))
     if like is not None and len(tuple(like)) != meta["nfields"]:
         raise ValueError(
             f"Checkpoint {path!r} holds {meta['nfields']} field(s) but "
             f"`like` has {len(tuple(like))}."
         )
 
-    pid = jax.process_index()
-    shard_path = os.path.join(path, f"shards_p{pid}.npz")
-    if not os.path.isfile(shard_path):
+    if strict:
+        if mismatch:
+            detail = ", ".join(
+                f"{k}: checkpoint {saved_grid.get(k)} vs current {current[k]}"
+                for k in mismatch
+            )
+            raise ValueError(
+                f"Checkpoint {path!r} was written for a different grid "
+                f"topology ({detail}). Re-init the global grid with the same "
+                f"local sizes and dims to restore it (or drop strict=True "
+                f"for an elastic restore)."
+            )
+        if not same_procs:
+            raise ValueError(
+                f"Checkpoint {path!r} was written by {meta['process_count']} "
+                f"process(es) but this job runs {jax.process_count()}; restart "
+                f"with the same process count (or drop strict=True for an "
+                f"elastic restore)."
+            )
+
+    if not mismatch and same_procs and os.path.isfile(shard_path):
+        try:
+            return _restore_same_topology(path, meta, gg, like)
+        except KeyError:
+            # Same topology and process count but a different
+            # device-to-process layout: this process's shard file lacks a
+            # block it now needs.  Strict keeps the original error; the
+            # elastic path below reassembles from all shard files.
+            if strict:
+                raise
+    elif strict:
         raise FileNotFoundError(
             f"Checkpoint {path!r} has no shard file for process {pid} "
             f"({shard_path}); it was written by a different process layout."
         )
+    return _restore_elastic(path, meta, gg, like)
+
+
+def _restore_same_topology(path, meta, gg, like):
+    """The bit-exact fast path: this process reads only its own shard file."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P, SingleDeviceSharding
+
+    pid = jax.process_index()
+    shard_path = os.path.join(path, _shard_name(pid))
     npz = np.load(shard_path)
 
     state = []
@@ -293,35 +496,217 @@ def restore_checkpoint(
                     f"device-to-process layout changed since the save."
                 )
             shape = tuple(int(s) for s in npz[key + "_shape"])
-            return np.frombuffer(npz[key].tobytes(), dtype=dtype).reshape(shape)
+            return npz[key].view(dtype).reshape(shape)
 
         state.append(jax.make_array_from_callback(gshape, sharding, lookup))
     return tuple(state), int(meta["step"]), meta.get("extra", {})
 
 
-def prune_checkpoints(directory: str | os.PathLike, *, keep: int = 2) -> list[str]:
-    """Delete all but the newest ``keep`` complete checkpoints (process 0
-    only; other ranks no-op).  Returns the removed paths."""
+def _saved_shard_files(path: str, meta: dict) -> list[str]:
+    """Every shard file of a checkpoint (manifest-driven for format 2, so
+    stray files from crashed earlier attempts cannot pollute an assembly)."""
+    shards = meta.get("shards")
+    if shards is not None:
+        return [os.path.join(path, name) for name in sorted(shards)]
+    return sorted(_glob.glob(os.path.join(path, "shards_p*.npz")))
+
+
+def _restore_elastic(path, meta, gg, like):
+    """Reshard-on-restore: reassemble each field's de-duplicated global
+    array from the saved per-block offsets (every shard file) and re-slice
+    it under the current grid's sharding."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P, SingleDeviceSharding
+
+    from ..ops import gather as _gather
+    from ..parallel.grid import elastic_topology_error
+
+    saved_grid = meta["grid"]
+    err = elastic_topology_error(saved_grid, gg.checkpoint_meta())
+    if err is not None:
+        raise ValueError(
+            f"Checkpoint {path!r} cannot be elastically restored on the "
+            f"current grid: {err}."
+        )
+    npzs = [np.load(p) for p in _saved_shard_files(path, meta)]
+    if not npzs:
+        raise FileNotFoundError(
+            f"Checkpoint {path!r} has no shard files to reassemble from."
+        )
+    nxyz_s = tuple(saved_grid["nxyz"])
+    over_s = tuple(saved_grid["overlaps"])
+    periods = tuple(saved_grid["periods"])
+    replicated_target = (
+        SingleDeviceSharding(gg.mesh.devices.flat[0])
+        if gg.nprocs == 1 and not gg.force_spmd
+        else NamedSharding(gg.mesh, P())
+    )
+
+    state = []
+    for i, fmeta in enumerate(meta["fields"]):
+        gshape = tuple(fmeta["global_shape"])
+        dtype = _dtype_from_str(fmeta["dtype"])
+        prefix = f"f{i}_o"
+        blocks: dict[tuple[int, ...], np.ndarray] = {}
+        bshape = None
+        for npz in npzs:
+            for key in npz.files:
+                if not key.startswith(prefix) or key.endswith("_shape"):
+                    continue
+                starts = tuple(int(s) for s in key[len(prefix):].split("_"))
+                shape = tuple(int(s) for s in npz[key + "_shape"])
+                if bshape is None:
+                    bshape = shape
+                elif shape != bshape:
+                    raise ValueError(
+                        f"Checkpoint {path!r} field {i} has blocks of "
+                        f"differing shapes ({bshape} vs {shape}); cannot "
+                        f"reassemble."
+                    )
+                coords = tuple(s // b for s, b in zip(starts, shape))
+                if coords in blocks:
+                    continue  # replicated block: every copy is identical
+                blocks[coords] = npz[key].view(dtype).reshape(shape)
+        if not blocks:
+            raise ValueError(
+                f"Checkpoint {path!r} holds no blocks for field {i}."
+            )
+
+        if bshape == gshape:
+            # Fully replicated field: one block IS the global value.
+            block = blocks[(0,) * len(gshape)]
+            sharding = (
+                tuple(like)[i].sharding if like is not None else replicated_target
+            )
+            if like is not None and tuple(tuple(like)[i].shape) != gshape:
+                raise ValueError(
+                    f"Checkpoint field {i} has global shape {gshape} but "
+                    f"`like[{i}]` has {tuple(tuple(like)[i].shape)}."
+                )
+            state.append(
+                jax.make_array_from_callback(
+                    gshape, sharding, lambda index, b=block: b[index]
+                )
+            )
+            continue
+
+        ndim = len(gshape)
+        nblocks = tuple(g // b for g, b in zip(gshape, bshape))
+        if len(blocks) != int(np.prod(nblocks)):
+            raise ValueError(
+                f"Checkpoint {path!r} field {i}: expected "
+                f"{int(np.prod(nblocks))} blocks ({nblocks} per dim), found "
+                f"{len(blocks)} across {len(npzs)} shard file(s); the "
+                f"checkpoint is incomplete."
+            )
+        # Per-dim overlap of THIS field under the saved grid (shape-aware:
+        # staggered n+1 fields carry overlap+1), then the de-dup extent.
+        ols_s = tuple(bshape[d] - (nxyz_s[d] - over_s[d]) for d in range(ndim))
+        if any(o < 0 for o in ols_s):
+            raise ValueError(
+                f"Checkpoint {path!r} field {i} (local shape {bshape}) does "
+                f"not follow the halo size convention (negative overlap "
+                f"{ols_s}); elastic restore cannot reassemble it."
+            )
+        glens = tuple(
+            _gather.dedup_length(nblocks[d], bshape[d], ols_s[d], bool(periods[d]))
+            for d in range(ndim)
+        )
+        glob = _gather.assemble_dedup(
+            blocks, bshape, nblocks, ols_s, periods[:ndim], dtype
+        )
+
+        # Target layout: the field keeps its stagger offset relative to the
+        # grid's local size (e.g. a +1-staggered Vx stays +1-staggered).
+        tshape = tuple(
+            gg.nxyz[d] + (bshape[d] - nxyz_s[d]) for d in range(ndim)
+        )
+        ols_t = tuple(
+            tshape[d] - (gg.nxyz[d] - gg.overlaps[d]) for d in range(ndim)
+        )
+        if any(o < 0 for o in ols_t) or any(s < 1 for s in tshape):
+            raise ValueError(
+                f"Checkpoint {path!r} field {i}: target local shape {tshape} "
+                f"(overlaps {ols_t}) is not realizable on the current grid."
+            )
+        glens_t = tuple(
+            _gather.dedup_length(gg.dims[d], tshape[d], ols_t[d], bool(periods[d]))
+            for d in range(ndim)
+        )
+        if glens_t != glens:
+            raise ValueError(
+                f"Checkpoint {path!r} field {i}: de-duplicated global extent "
+                f"{glens} under the save does not match {glens_t} under the "
+                f"current grid."
+            )
+        new_gshape = tuple(gg.dims[d] * tshape[d] for d in range(ndim))
+        if like is not None:
+            sharding = tuple(like)[i].sharding
+            if tuple(tuple(like)[i].shape) != new_gshape:
+                raise ValueError(
+                    f"Checkpoint field {i} reshards to global shape "
+                    f"{new_gshape} on the current grid but `like[{i}]` has "
+                    f"{tuple(tuple(like)[i].shape)}."
+                )
+        elif gg.nprocs == 1 and not gg.force_spmd:
+            sharding = SingleDeviceSharding(gg.mesh.devices.flat[0])
+        else:
+            sharding = NamedSharding(gg.mesh, P(*AXIS_NAMES[:ndim]))
+
+        def lookup(index, glob=glob, tshape=tshape, ols_t=ols_t, glens=glens,
+                   new_gshape=new_gshape):
+            starts = _index_starts(index, new_gshape)
+            idxs = [
+                _gather.dedup_indices(
+                    starts[d] // tshape[d], 0, tshape[d], tshape[d], ols_t[d],
+                    glens[d],
+                )
+                for d in range(len(tshape))
+            ]
+            return glob[np.ix_(*idxs)]
+
+        state.append(jax.make_array_from_callback(new_gshape, sharding, lookup))
+        del glob
+    return tuple(state), int(meta["step"]), meta.get("extra", {})
+
+
+def prune_checkpoints(
+    directory: str | os.PathLike, *, keep: int = 2, protect_valid: bool = True
+) -> list[str]:
+    """Delete all but the newest ``keep`` checkpoints (process 0 only; other
+    ranks no-op).  Returns the removed paths.
+
+    ``protect_valid`` (default): pruning refuses to delete the only
+    integrity-verified generation — if none of the ``keep`` newest pass
+    `verify_checkpoint`, the newest VALID older generation is retained too,
+    so retention can never destroy the last restorable state.
+    """
     import jax
 
     if keep < 1:
         raise ValueError(f"keep must be >= 1 (got {keep})")
     if jax.process_index() != 0:
         return []
-    directory = os.fspath(directory)
-    if not os.path.isdir(directory):
-        return []
-    complete = []
-    for name in sorted(os.listdir(directory)):
-        path = os.path.join(directory, name)
-        if name.startswith("step_") and os.path.isfile(os.path.join(path, _META)):
-            try:
-                complete.append((int(name[len("step_"):]), path))
-            except ValueError:
-                continue
-    complete.sort()
+    complete = checkpoint_steps(directory)
+    doomed = complete[:-keep]
+    if protect_valid and doomed:
+        # Newest-first: on the hot cadence (RunGuard prunes right after a
+        # save) the first candidate is the just-published generation — one
+        # warm CRC pass short-circuits the scan in the all-healthy case.
+        kept = complete[-keep:]
+        if not any(verify_checkpoint(p) is None for _, p in reversed(kept)):
+            for entry in reversed(doomed):
+                if verify_checkpoint(entry[1]) is None:
+                    doomed.remove(entry)
+                    print(
+                        f"[igg.checkpoint] prune: keeping {entry[1]} — it is "
+                        f"the only generation passing integrity verification",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    break
     removed = []
-    for _, path in complete[:-keep]:
+    for _, path in doomed:
         shutil.rmtree(path, ignore_errors=True)
         removed.append(path)
     return removed

@@ -45,9 +45,16 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_CHECKPOINT_EVERY``  default checkpoint cadence for the models' time
                           loops (int >= 0; 0 = off)
 ``IGG_CHECKPOINT_DIR``    default checkpoint directory (`utils.checkpoint`)
+``IGG_CHECKPOINT_KEEP``   checkpoint retention for the models' time loops
+                          (int >= 0; 0 = keep every generation): after each
+                          save, prune to the newest N generations — pruning
+                          never deletes the only integrity-verified one
 ``IGG_FAULT_INJECT``      fault-injection knob for the test/soak harness:
-                          ``init_flake:N`` | ``halo_corrupt:stepN[:procP]``
-                          | ``worker_crash:stepN[:procP]`` (docs/robustness.md)
+                          ``init_flake:N`` | ``halo_corrupt:stepN[:blockB]``
+                          | ``worker_crash:stepN[:procP]``
+                          | ``ckpt_corrupt:stepN[:shardS]``
+                          | ``ckpt_truncate:stepN[:shardS]``; several faults
+                          compose comma-separated (docs/robustness.md)
 ========================  ====================================================
 
 Explicit kwargs always win over env values; env values win over built-in
@@ -218,6 +225,12 @@ def checkpoint_dir_env() -> str | None:
     """``IGG_CHECKPOINT_DIR``: default checkpoint directory."""
     val = os.environ.get("IGG_CHECKPOINT_DIR")
     return val or None
+
+
+def checkpoint_keep_env() -> int | None:
+    """``IGG_CHECKPOINT_KEEP``: retention depth in generations (>= 0;
+    0 = keep every generation)."""
+    return _int_env("IGG_CHECKPOINT_KEEP", minimum=0)
 
 
 def fault_inject_env() -> str | None:

@@ -20,9 +20,11 @@ production stack ships first:
   policy inside the models' time loops (``guard_every=N``).
 * **Fault injection** — `FaultInjector` parses ``IGG_FAULT_INJECT``
   (``init_flake:N``, ``halo_corrupt:stepN[:blockB]``,
-  ``worker_crash:stepN[:procP]``) so the 2-process `test_distributed.py`
-  path and `scripts/soak.py` can prove crash→restart-from-checkpoint and
-  corruption→guard-trip end to end.
+  ``worker_crash:stepN[:procP]``, ``ckpt_corrupt:stepN[:shardS]``,
+  ``ckpt_truncate:stepN[:shardS]``; several compose comma-separated via
+  `FaultSet`) so the 2-process `test_distributed.py` path and
+  `scripts/soak.py` can prove crash→restart-from-checkpoint,
+  corruption→guard-trip and damaged-generation fallback end to end.
 
 Checkpoint/restart itself lives in `utils.checkpoint`; `RunGuard` drives it.
 """
@@ -50,6 +52,7 @@ __all__ = [
     "FieldReport",
     "RunGuard",
     "FaultInjector",
+    "FaultSet",
     "backoff_schedule",
     "retry_call",
     "watchdog",
@@ -403,7 +406,21 @@ def check_fields(*fields, names: Sequence[str] | None = None) -> FieldReport:
 
 # -- Fault injection ----------------------------------------------------------
 
-FAULT_KINDS = ("init_flake", "halo_corrupt", "worker_crash")
+FAULT_KINDS = (
+    "init_flake",
+    "halo_corrupt",
+    "worker_crash",
+    "ckpt_corrupt",
+    "ckpt_truncate",
+)
+
+#: third spec component's prefix per fault kind (e.g. ``halo_corrupt:step3:block5``)
+_TARGET_PREFIX = {
+    "halo_corrupt": "block",
+    "worker_crash": "proc",
+    "ckpt_corrupt": "shard",
+    "ckpt_truncate": "shard",
+}
 
 
 @dataclasses.dataclass
@@ -424,9 +441,18 @@ class FaultInjector:
     * ``worker_crash:stepN[:procP]`` — after time-loop step ``N`` (and after
       that step's checkpoint), process ``P`` (default: the last process)
       exits hard with status 17.  Proves crash→restart-from-checkpoint.
+    * ``ckpt_corrupt:stepN[:shardS]`` — right after the step-``N`` checkpoint
+      publishes, a byte of shard file ``S`` (default 0) is flipped WITHOUT
+      updating the manifest (process 0 applies it).  Proves the CRC
+      verification + generation fallback of `utils.checkpoint`.
+    * ``ckpt_truncate:stepN[:shardS]`` — same, but the shard file is
+      truncated to half its size (a torn write).
 
     Each fault fires once per injector (a rolled-back or restarted run does
-    not re-trip), mirroring how real transient faults behave.
+    not re-trip), mirroring how real transient faults behave.  Several
+    faults compose as a comma-separated spec (parsed by `FaultSet`), e.g.
+    ``worker_crash:step4:proc1,ckpt_corrupt:step4`` — crash AND damaged
+    newest generation in one run, the elastic-failover drill.
     """
 
     kind: str | None = None
@@ -459,7 +485,7 @@ class FaultInjector:
                     f"attempts to fail."
                 )
             return cls(kind=kind, count=int(parts[1]))
-        tgt_prefix = "block" if kind == "halo_corrupt" else "proc"
+        tgt_prefix = _TARGET_PREFIX[kind]
         if len(parts) not in (2, 3) or not parts[1].startswith("step"):
             raise ValueError(
                 f"IGG_FAULT_INJECT: {spec!r} — {kind} takes "
@@ -476,14 +502,14 @@ class FaultInjector:
         target = None
         if len(parts) == 3:
             if not parts[2].startswith(tgt_prefix):
+                what = {
+                    "block": "a block rank.",
+                    "proc": "a process index.",
+                    "shard": "a shard (writer process) index.",
+                }[tgt_prefix]
                 raise ValueError(
                     f"IGG_FAULT_INJECT: {spec!r} — the third component must "
-                    f"be '{tgt_prefix}P' with P "
-                    + (
-                        "a block rank."
-                        if kind == "halo_corrupt"
-                        else "a process index."
-                    )
+                    f"be '{tgt_prefix}P' with P " + what
                 )
             try:
                 target = int(parts[2][len(tgt_prefix):])
@@ -572,6 +598,98 @@ class FaultInjector:
         os._exit(self.CRASH_STATUS)
 
 
+    # - ckpt_corrupt / ckpt_truncate -
+
+    def maybe_damage_checkpoint(self, step_dir: str, step: int) -> None:
+        """After the step-``step`` checkpoint published: damage one shard.
+
+        Called by `utils.checkpoint.save_checkpoint` on process 0 right
+        after the atomic rename — the manifest already vouches for the
+        intact bytes, so the damage is exactly what `verify_checkpoint`
+        exists to catch.  ``ckpt_corrupt`` flips one byte mid-file (CRC
+        mismatch); ``ckpt_truncate`` halves the file (size mismatch).
+        """
+        if (
+            self.kind not in ("ckpt_corrupt", "ckpt_truncate")
+            or self.fired
+            or step != self.step
+        ):
+            return
+        self.fired = True
+        shard = os.path.join(step_dir, f"shards_p{self.target or 0}.npz")
+        size = os.path.getsize(shard)
+        if self.kind == "ckpt_truncate":
+            os.truncate(shard, size // 2)
+            what = f"truncated to {size // 2} of {size} bytes"
+        else:
+            with open(shard, "r+b") as f:
+                f.seek(size // 2)
+                byte = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            what = f"flipped byte at offset {size // 2}"
+        print(
+            f"[igg.resilience] IGG_FAULT_INJECT({self.kind}): {what} in "
+            f"{shard} after step {step}",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
+@dataclasses.dataclass
+class FaultSet:
+    """Several `FaultInjector`s armed at once (comma-separated spec).
+
+    The process-wide injector `get_fault_injector` returns: every hook
+    point (`maybe_flake_init`, `maybe_corrupt`, `maybe_crash`,
+    `maybe_damage_checkpoint`, `corrupt_halo_hook`) fans out to each armed
+    fault, so e.g. ``worker_crash:step4:proc1,ckpt_corrupt:step4`` crashes
+    a worker AND damages the newest checkpoint generation in one run — the
+    supervised-failover drill `scripts/soak.py` and the 2-process elastic
+    restart test run.
+    """
+
+    injectors: tuple = ()
+
+    @classmethod
+    def from_spec(cls, spec: str | None) -> "FaultSet":
+        if not spec:
+            return cls()
+        return cls(
+            tuple(
+                FaultInjector.from_spec(part.strip())
+                for part in spec.split(",")
+                if part.strip()
+            )
+        )
+
+    @property
+    def active(self) -> bool:
+        return any(i.active for i in self.injectors)
+
+    def maybe_flake_init(self) -> None:
+        for i in self.injectors:
+            i.maybe_flake_init()
+
+    def maybe_corrupt(self, state: tuple, step: int) -> tuple:
+        for i in self.injectors:
+            state = i.maybe_corrupt(state, step)
+        return state
+
+    def corrupt_halo_hook(self, fields: tuple) -> tuple:
+        for i in self.injectors:
+            fields = i.corrupt_halo_hook(fields)
+        return fields
+
+    def maybe_crash(self, step: int) -> None:
+        for i in self.injectors:
+            i.maybe_crash(step)
+
+    def maybe_damage_checkpoint(self, step_dir: str, step: int) -> None:
+        for i in self.injectors:
+            i.maybe_damage_checkpoint(step_dir, step)
+
+
 def _last_process_index() -> int:
     try:
         import jax
@@ -602,12 +720,13 @@ def _block_interior_index(A, block_rank: int) -> tuple:
     )
 
 
-_injector: FaultInjector | None = None
+_injector: FaultSet | None = None
 _injector_spec: str | None = None
 
 
-def get_fault_injector() -> FaultInjector:
-    """The process-wide injector for the current ``IGG_FAULT_INJECT`` value.
+def get_fault_injector() -> FaultSet:
+    """The process-wide injector for the current ``IGG_FAULT_INJECT`` value
+    (a `FaultSet`: the spec may arm several comma-separated faults).
 
     Cached per spec string so fired/remaining state persists across calls;
     changing the env var re-arms automatically, `reset_fault_injector`
@@ -616,7 +735,7 @@ def get_fault_injector() -> FaultInjector:
     global _injector, _injector_spec
     spec = _config.fault_inject_env()
     if _injector is None or spec != _injector_spec:
-        _injector = FaultInjector.from_spec(spec)
+        _injector = FaultSet.from_spec(spec)
         _injector_spec = spec
     return _injector
 
@@ -708,7 +827,9 @@ class RunGuard:
     Per step, in order: (1) fault injection (``halo_corrupt``), (2) the
     NaN/Inf guard every ``guard_every`` steps with the ``raise`` | ``warn``
     | ``rollback`` policy, (3) checkpoint every ``checkpoint_every`` steps
-    (only ever of guard-passed state), (4) fault injection
+    (only ever of guard-passed state) followed by retention pruning when
+    ``checkpoint_keep`` (``IGG_CHECKPOINT_KEEP``) is set — pruning never
+    deletes the only integrity-verified generation, (4) fault injection
     (``worker_crash`` — after the checkpoint, so restart resumes exactly at
     the crash point).  Rollback restores the last good snapshot (in-memory;
     the disk checkpoint serves cross-process restart) and rewinds ``it``.
@@ -724,14 +845,16 @@ class RunGuard:
         policy: str | None = None,
         checkpoint_every: int | None = None,
         checkpoint_dir: str | None = None,
+        checkpoint_keep: int | None = None,
         names: Sequence[str] | None = None,
         max_rollbacks: int = 3,
-        injector: FaultInjector | None = None,
+        injector: "FaultInjector | FaultSet | None" = None,
     ):
         env_ge = _config.guard_every_env()
         env_pol = _config.guard_policy_env()
         env_ce = _config.checkpoint_every_env()
         env_dir = _config.checkpoint_dir_env()
+        env_keep = _config.checkpoint_keep_env()
         self.guard_every = int(
             guard_every if guard_every is not None else (env_ge or 0)
         )
@@ -742,11 +865,22 @@ class RunGuard:
         self.checkpoint_dir = (
             checkpoint_dir if checkpoint_dir is not None else env_dir
         )
+        # Retention: keep this many newest generations, pruning the rest
+        # after every save (0 = unbounded).  Pruning never deletes the only
+        # integrity-verified generation (`checkpoint.prune_checkpoints`).
+        self.checkpoint_keep = int(
+            checkpoint_keep if checkpoint_keep is not None else (env_keep or 0)
+        )
         if self.guard_every < 0:
             raise ValueError(f"guard_every must be >= 0 (got {self.guard_every})")
         if self.checkpoint_every < 0:
             raise ValueError(
                 f"checkpoint_every must be >= 0 (got {self.checkpoint_every})"
+            )
+        if self.checkpoint_keep < 0:
+            raise ValueError(
+                f"checkpoint_keep must be >= 0 (got {self.checkpoint_keep}; "
+                f"0 keeps every generation)"
             )
         if self.policy not in _config.GUARD_POLICIES:
             raise ValueError(
@@ -783,7 +917,11 @@ class RunGuard:
 
             latest = _ckpt.latest_checkpoint(self.checkpoint_dir)
             if latest is not None:
-                state, it, _ = _ckpt.restore_checkpoint(latest, like=state)
+                # latest_checkpoint already CRC-verified this generation:
+                # don't pay a second full read+CRC pass over every shard.
+                state, it, _ = _ckpt.restore_checkpoint(
+                    latest, like=state, verify=False
+                )
                 print(
                     f"[igg.resilience] resumed from checkpoint {latest} "
                     f"(step {it})",
@@ -816,6 +954,10 @@ class RunGuard:
             from . import checkpoint as _ckpt
 
             _ckpt.save_checkpoint(self.checkpoint_dir, state, it)
+            if self.checkpoint_keep:
+                _ckpt.prune_checkpoints(
+                    self.checkpoint_dir, keep=self.checkpoint_keep
+                )
         self._injector.maybe_crash(it)
         return state, it
 

@@ -22,9 +22,22 @@ Scenarios:
 * ``worker_crash`` — the process hard-exits (status 17) right after a
   checkpoint; the orchestrator restarts it against the same checkpoint
   directory and the resumed run must complete bit-identical.
+* ``elastic_failover`` — the SUPERVISED failover drill: a real 2-process
+  gloo pair runs the job (dims (2,1,1)); process 1 is crash-injected right
+  after the mid-run checkpoint AND that newest generation is corrupt-injected
+  (``worker_crash:stepM:proc1,ckpt_corrupt:stepM``).  The supervisor detects
+  the crash, relaunches on a SHRUNK 1-process topology (same implicit global
+  grid, adjusted local size) against the same checkpoint directory — the
+  restart must fall back past the damaged generation to the newest valid one,
+  reshard the 2-process shards elastically, and finish matching a
+  never-crashed oracle in de-duplicated (nxyz_g) space.
 
 Each scenario runs in a fresh child process (a crash must not take the
 orchestrator down, and init faults need a pristine runtime).
+
+``--quick`` runs only the ``elastic_failover`` drill at small size — the
+fast crash→shrunk-topology-restart smoke path (registered next to the
+tier-1 command in docs/testing.md).
 """
 
 from __future__ import annotations
@@ -39,7 +52,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 CRASH_STATUS = 17  # FaultInjector.CRASH_STATUS
-SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash")
+SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash", "elastic_failover")
 
 
 def _free_port() -> int:
@@ -96,6 +109,78 @@ def child_main(args) -> int:
         print("SOAK CHILD: non-finite final field", file=sys.stderr)
         return 1
     np.save(args.out, arr)
+    print("SOAK CHILD OK", flush=True)
+    return 0
+
+
+def child_elastic_main(args) -> int:
+    """One worker of the elastic-failover drill.
+
+    ``--nproc 2`` = one member of the gloo pair (dims (2,1,1), local
+    ``nx^3``); ``--nproc 1`` = the single-process topology spanning the SAME
+    implicit global grid (local ``(2*nx-2, nx, nx)``) — the oracle run, or
+    the shrunk restart when ``--ckpt-dir`` points at the pair's directory.
+    """
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    pid = args.pair_id
+    if args.nproc > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.utils import resilience
+
+    resilience.arm_watchdog(max(30, args.timeout - 40), exit=True)
+    if args.nproc > 1:
+        nxyz = (args.nx, args.nx, args.nx)
+        grid_kwargs = dict(
+            init_distributed=True,
+            distributed_kwargs=dict(
+                coordinator_address=f"127.0.0.1:{args.port}",
+                num_processes=args.nproc,
+                process_id=pid,
+            ),
+        )
+    else:
+        # same nxyz_g as the pair's (2,1,1) decomposition: 2*(nx-2)+2
+        nxyz = (2 * args.nx - 2, args.nx, args.nx)
+        grid_kwargs = {}
+    igg.init_global_grid(*nxyz, quiet=(pid != 0), **grid_kwargs)
+
+    if args.expect_resume_step >= 0:
+        latest = igg.latest_checkpoint(args.ckpt_dir)
+        want = f"step_{args.expect_resume_step:08d}"
+        assert latest is not None and latest.endswith(want), (
+            f"expected the restart to fall back to the valid {want} "
+            f"generation, found {latest!r}"
+        )
+
+    state, params = diffusion3d.setup(*nxyz, init_grid=False)
+    step = diffusion3d.make_step(params)
+    guard = resilience.RunGuard(
+        checkpoint_every=2 if args.ckpt_dir else 0,
+        checkpoint_dir=args.ckpt_dir,
+        names=("T", "Cp"),
+    )
+    state = resilience.guarded_time_loop(
+        step, state, args.steps, guard=guard, sync_every_step=True
+    )
+    T = diffusion3d.temperature(state)
+    dd = igg.gather(T, dedup=True, root=0)
+    if jax.process_index() == 0:
+        assert dd is not None and np.isfinite(dd).all()
+        np.save(args.out, dd)
+    igg.finalize_global_grid()
     print("SOAK CHILD OK", flush=True)
     return 0
 
@@ -161,21 +246,146 @@ def _report(name: str, ok: bool, detail: str = "") -> bool:
     return ok
 
 
+def _elastic_cmd(args, *, nproc, pair_id, port, ckpt, out, expect_resume=-1):
+    return [
+        sys.executable, os.path.abspath(__file__), "--elastic-child",
+        "--steps", str(args.steps), "--nx", str(args.nx),
+        "--nproc", str(nproc), "--pair-id", str(pair_id),
+        "--port", str(port), "--timeout", str(args.timeout),
+        "--ckpt-dir", ckpt or "", "--out", out or "",
+        "--expect-resume-step", str(expect_resume),
+    ]
+
+
+def _elastic_env(env_extra: dict) -> dict:
+    env = dict(os.environ)
+    env.pop("IGG_FAULT_INJECT", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p
+    )
+    env.update(env_extra)
+    return env
+
+
+def supervise_elastic_failover(args) -> bool:
+    """The supervisor: run the 2-process job, detect the injected crash,
+    relaunch on a shrunk 1-process topology from the latest VALID
+    checkpoint, and verify against a never-crashed oracle."""
+    import shutil
+
+    import numpy as np
+
+    workdir = args.workdir
+    ckpt = os.path.join(workdir, "ckpt_elastic")
+    shutil.rmtree(ckpt, ignore_errors=True)
+    if args.steps < 6:
+        return _report(
+            "elastic", False,
+            f"--steps {args.steps} too small: the drill needs a valid "
+            f"generation BEFORE the corrupted crash checkpoint (>= 6 steps)",
+        )
+    # a checkpointed step with at least one earlier generation to fall
+    # back to once the crash-step generation is corrupted
+    mid = max(4, (args.steps // 2) // 2 * 2)
+
+    # (1) never-crashed oracle on the single-process topology
+    oracle_out = os.path.join(workdir, "elastic_oracle.npy")
+    proc = _run_child(
+        _elastic_cmd(args, nproc=1, pair_id=0, port=0, ckpt=None, out=oracle_out),
+        _elastic_env({}), args.timeout,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        return _report("elastic", False, f"oracle rc={proc.returncode}")
+
+    # (2) the 2-process job with crash + newest-generation corruption armed
+    port = _free_port()
+    env = _elastic_env(
+        {"IGG_FAULT_INJECT": f"worker_crash:step{mid}:proc1,ckpt_corrupt:step{mid}"}
+    )
+    logs = [
+        open(os.path.join(workdir, f"elastic_pair{pid}.log"), "w+")
+        for pid in range(2)
+    ]
+    procs = [
+        subprocess.Popen(
+            _elastic_cmd(args, nproc=2, pair_id=pid, port=port, ckpt=ckpt,
+                         out=os.path.join(workdir, "elastic_never.npy")),
+            env=env, stdout=logs[pid], stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    try:
+        try:
+            procs[1].wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return _report("elastic", False, "pair run timed out before the crash")
+        # crash detected: reap the stranded survivor like any supervisor would
+        try:
+            procs[0].wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            procs[0].wait()
+        if procs[1].returncode != CRASH_STATUS:
+            logs[1].flush()
+            logs[1].seek(0)
+            print(logs[1].read(), file=sys.stderr)
+            return _report(
+                "elastic", False,
+                f"expected crash rc={CRASH_STATUS}, got {procs[1].returncode}",
+            )
+    finally:
+        for f in logs:
+            f.close()
+
+    # (3) relaunch on the SHRUNK 1-process topology: must fall back past the
+    # corrupt step-`mid` generation to step `mid`-2 and reshard elastically
+    got_out = os.path.join(workdir, "elastic_resumed.npy")
+    proc = _run_child(
+        _elastic_cmd(args, nproc=1, pair_id=0, port=0, ckpt=ckpt, out=got_out,
+                     expect_resume=mid - 2),
+        _elastic_env({}), args.timeout,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        return _report("elastic", False, f"shrunk restart rc={proc.returncode}")
+    oracle = np.load(oracle_out)
+    got = np.load(got_out)
+    ok = got.shape == oracle.shape and np.allclose(
+        got, oracle, rtol=1e-13, atol=1e-13
+    )
+    return _report(
+        "elastic", ok,
+        f"crash rc=17 -> fallback to step {mid - 2} -> 1-proc restart "
+        f"(max |err| {np.max(np.abs(got - oracle)) if got.shape == oracle.shape else 'shape mismatch'})",
+    )
+
+
 def orchestrate(args) -> int:
     import numpy as np
 
     os.makedirs(args.workdir, exist_ok=True)
     failures = 0
 
-    proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
-    if proc.returncode != 0:
-        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
-        _report("baseline", False, f"rc={proc.returncode}")
-        return 1
-    baseline = np.load(base_out)
-    _report("baseline", True, f"steps={args.steps} nx={args.nx}")
+    # The elastic drill carries its own oracle (a different topology); the
+    # shared 8-device baseline is only needed by the other scenarios.
+    baseline = None
+    if any(s != "elastic_failover" for s in args.scenarios):
+        proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
+        if proc.returncode != 0:
+            print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+            _report("baseline", False, f"rc={proc.returncode}")
+            return 1
+        baseline = np.load(base_out)
+        _report("baseline", True, f"steps={args.steps} nx={args.nx}")
 
     for scenario in args.scenarios:
+        if scenario == "elastic_failover":
+            if not supervise_elastic_failover(args):
+                failures += 1
+            continue
         if scenario == "init_flake":
             env = {
                 "IGG_FAULT_INJECT": "init_flake:2",
@@ -245,15 +455,32 @@ def main() -> int:
     ap.add_argument("--scenarios", nargs="+", default=list(SCENARIOS),
                     choices=list(SCENARIOS))
     ap.add_argument("--timeout", type=int, default=600)
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="fast fault smoke path: only the elastic_failover drill "
+        "(crash -> fallback past the corrupt generation -> shrunk-topology "
+        "restart) at small size — the CI lane registered in docs/testing.md",
+    )
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--elastic-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
     ap.add_argument("--distributed", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--pair-id", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--nproc", type=int, default=1, help=argparse.SUPPRESS)
+    ap.add_argument("--expect-resume-step", type=int, default=-1,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.elastic_child:
+        return child_elastic_main(args)
     if args.child:
         return child_main(args)
+    if args.quick:
+        args.scenarios = ["elastic_failover"]
+        args.steps = min(args.steps, 6)
+        args.timeout = min(args.timeout, 300)
     return orchestrate(args)
 
 

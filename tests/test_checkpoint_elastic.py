@@ -1,0 +1,408 @@
+"""Topology-elastic checkpoint/restart with integrity verification.
+
+The tentpole of the elastic-restart subsystem (docs/robustness.md): a
+checkpoint is a portable snapshot of the IMPLICIT global grid, restorable
+under any topology implying the same ``nxyz_g``; a damaged generation is
+detected (per-shard CRC32 manifest) and skipped, falling back to the
+newest valid one.  The cross-process legs live in `test_distributed.py`
+(`test_elastic_restart_shrunk_topology`).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import diffusion3d
+from implicitglobalgrid_tpu.ops import gather as gather_mod
+from implicitglobalgrid_tpu.parallel import grid as grid_mod
+from implicitglobalgrid_tpu.parallel import topology
+from implicitglobalgrid_tpu.utils import checkpoint as ckpt
+from implicitglobalgrid_tpu.utils import resilience as res
+
+NX = 8
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("IGG_"):
+            monkeypatch.delenv(k)
+    res.reset_fault_injector()
+    yield
+    res.reset_fault_injector()
+
+
+def _coord_state(tshape=(NX, NX, NX), vshape=(NX + 1, NX, NX)):
+    """Globally-consistent fields (coordinate-derived: duplicated overlap
+    cells agree by construction, like a post-exchange state)."""
+    T0 = igg.zeros(tshape)
+    X, Y, Z = igg.coord_fields(T0, (0.37, 0.11, 0.53))
+    T = X * 1.3 + Y * 0.7 + Z * 0.11 + X * Y * 0.003
+    V0 = igg.zeros(vshape)
+    Xs, Ys, Zs = igg.coord_fields(V0, (0.37, 0.11, 0.53))
+    Vx = Xs * 0.9 - Ys * 0.2 + Zs * 0.05
+    return T, Vx
+
+
+# -- topology admissibility ----------------------------------------------------
+
+
+def test_implied_global_shape_is_inits_formula():
+    assert topology.implied_global_shape((8, 8, 8), (2, 2, 2), (2, 2, 2), (0, 0, 0)) == (14, 14, 14)
+    assert topology.implied_global_shape((8, 8, 8), (2, 2, 2), (2, 2, 2), (0, 0, 1)) == (14, 14, 12)
+    igg.init_global_grid(NX, NX, NX, periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    assert gg.nxyz_g == topology.implied_global_shape(
+        gg.nxyz, gg.dims, gg.overlaps, gg.periods
+    )
+
+
+def test_elastic_topology_error_names_the_mismatch():
+    saved = dict(nxyz=[8, 8, 8], dims=[2, 2, 2], overlaps=[2, 2, 2],
+                 periods=[0, 0, 0], nxyz_g=[14, 14, 14])
+    ok = dict(nxyz=[5, 14, 8], dims=[4, 1, 2], overlaps=[2, 2, 2],
+              periods=[0, 0, 0])
+    assert grid_mod.elastic_topology_error(saved, ok) is None
+    bad_size = dict(ok, nxyz=[6, 14, 8])
+    err = grid_mod.elastic_topology_error(saved, bad_size)
+    assert err is not None and "implied global size" in err
+    bad_period = dict(ok, periods=[1, 0, 0])
+    err = grid_mod.elastic_topology_error(saved, bad_period)
+    assert err is not None and "periods" in err
+
+
+# -- reshard-on-restore --------------------------------------------------------
+
+
+def _save_222(tmp_path, periodz=0):
+    igg.init_global_grid(NX, NX, NX, periodz=periodz, quiet=True)  # dims (2,2,2)
+    T, Vx = _coord_state()
+    dd = (igg.gather(T, dedup=True), igg.gather(Vx, dedup=True))
+    path = igg.save_checkpoint(tmp_path, (T, Vx), 7, extra={"model": "t"})
+    igg.finalize_global_grid()
+    return path, dd
+
+
+def test_restore_resharded_4x1x2_bit_exact(tmp_path):
+    """The acceptance topology: dims (2,2,2) -> (4,1,2), local sizes
+    adjusted so nxyz_g (14,14,14) is preserved."""
+    path, (dd_T, dd_Vx) = _save_222(tmp_path)
+    igg.init_global_grid(5, 14, 8, dimx=4, dimy=1, dimz=2, quiet=True)
+    (T2, Vx2), step, extra = igg.restore_checkpoint(path)
+    assert step == 7 and extra == {"model": "t"}
+    assert T2.shape == (20, 14, 16) and Vx2.shape == (24, 14, 16)
+    assert igg.gather(T2, dedup=True).tobytes() == dd_T.tobytes()
+    assert igg.gather(Vx2, dedup=True).tobytes() == dd_Vx.tobytes()
+    # the restored halos are consistent: an exchange is a bitwise no-op
+    T2x = igg.update_halo(T2 + 0)
+    np.testing.assert_array_equal(np.asarray(T2x), np.asarray(T2))
+
+
+def test_restore_resharded_2x2x1_shrunk_device_set(tmp_path):
+    """The surviving-slice topology: dims (2,2,2) on 8 devices -> (2,2,1)
+    on a 4-device subset, z-local size grown to keep nxyz_g."""
+    path, (dd_T, dd_Vx) = _save_222(tmp_path)
+    igg.init_global_grid(
+        NX, NX, 14, dimx=2, dimy=2, dimz=1, quiet=True,
+        devices=jax.devices()[:4],
+    )
+    (T2, Vx2), step, _ = igg.restore_checkpoint(path)
+    assert T2.shape == (16, 16, 14)
+    assert igg.gather(T2, dedup=True).tobytes() == dd_T.tobytes()
+    assert igg.gather(Vx2, dedup=True).tobytes() == dd_Vx.tobytes()
+
+
+def test_restore_resharded_periodic_dim(tmp_path):
+    """Periodic z: the de-dup identity wraps at the seam (nxyz_g_z = 12);
+    staggered + periodic fields reshard bit-exactly too."""
+    path, (dd_T, dd_Vx) = _save_222(tmp_path, periodz=1)
+    igg.init_global_grid(5, 14, 8, dimx=4, dimy=1, dimz=2, periodz=1, quiet=True)
+    (T2, Vx2), _, _ = igg.restore_checkpoint(path)
+    assert igg.gather(T2, dedup=True).tobytes() == dd_T.tobytes()
+    assert igg.gather(Vx2, dedup=True).tobytes() == dd_Vx.tobytes()
+    T2x = igg.update_halo(T2 + 0)
+    np.testing.assert_array_equal(np.asarray(T2x), np.asarray(T2))
+
+
+def test_restore_resharded_thin_slab_offset_coord_collision(tmp_path):
+    """Regression: with more blocks than cells-per-block along a dim (dims
+    (8,1,1), local nx=5), a block's byte OFFSET tuple (e.g. (5,0,0)) equals
+    another block's COORDS tuple — the elastic reader's duplicate-block skip
+    must compare in coordinate space, not offset space, or valid blocks are
+    dropped as 'replicated' and the restore fails as incomplete.  The
+    collision only fires when the high-coords block is SCANNED first (e.g.
+    `shards_p10.npz` sorting before `shards_p2.npz` on a pod), so the shard
+    file is rewritten with its keys reversed — block scan order is not part
+    of the format and must not matter."""
+    igg.init_global_grid(5, NX, NX, dimx=8, quiet=True)  # nxyz_g (26,8,8)
+    T, _ = _coord_state(tshape=(5, NX, NX), vshape=(6, NX, NX))
+    dd = igg.gather(T, dedup=True)
+    path = igg.save_checkpoint(tmp_path, (T,), 1)
+    igg.finalize_global_grid()
+    shard = os.path.join(path, "shards_p0.npz")
+    npz = np.load(shard)
+    payload = {k: npz[k] for k in reversed(npz.files)}
+    npz.close()
+    with open(shard, "wb") as f:
+        np.savez(f, **payload)
+    meta_path = os.path.join(path, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["shards"]["shards_p0.npz"] = {
+        "bytes": os.path.getsize(shard),
+        "crc32": ckpt._crc32_file(shard),
+    }
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    assert igg.verify_checkpoint(path) is None
+    igg.init_global_grid(8, NX, NX, dimx=4, quiet=True,
+                         devices=jax.devices()[:4])  # 4*(8-2)+2 = 26
+    (T2,), _, _ = igg.restore_checkpoint(path)
+    assert igg.gather(T2, dedup=True).tobytes() == dd.tobytes()
+
+
+def test_restore_resharded_respects_like_shardings(tmp_path):
+    path, (dd_T, dd_Vx) = _save_222(tmp_path)
+    igg.init_global_grid(5, 14, 8, dimx=4, dimy=1, dimz=2, quiet=True)
+    like = (igg.zeros((5, 14, 8)), igg.zeros((6, 14, 8)))
+    (T2, Vx2), _, _ = igg.restore_checkpoint(path, like=like)
+    assert T2.sharding.is_equivalent_to(like[0].sharding, T2.ndim)
+    assert igg.gather(T2, dedup=True).tobytes() == dd_T.tobytes()
+    with pytest.raises(ValueError, match="reshards to global shape"):
+        igg.restore_checkpoint(path, like=(igg.zeros((5, 14, 8)),) * 2)
+
+
+def test_restore_elastic_model_continuation_matches_oracle(tmp_path, clean_env):
+    """Save a guarded diffusion run mid-flight at dims (2,2,2), resume it at
+    dims (4,1,2) through the models' RunGuard path, and match the
+    never-resharded oracle in de-dup space (decomposition invariance)."""
+    # oracle: uninterrupted 6 steps at (2,2,2)
+    T_full = diffusion3d.run(6, NX, NX, NX, quiet=True, finalize=False)
+    oracle = igg.gather(T_full, dedup=True)
+    igg.finalize_global_grid()
+    # checkpointed partial run at (2,2,2)
+    diffusion3d.run(4, NX, NX, NX, checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True)
+    # resume at (4,1,2): same nxyz_g (14,14,14) from local (5,14,8)
+    T_res = diffusion3d.run(
+        6, 5, 14, 8, dimx=4, dimy=1, dimz=2,
+        checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True, finalize=False,
+    )
+    got = igg.gather(T_res, dedup=True)
+    igg.finalize_global_grid()
+    np.testing.assert_allclose(got, oracle, rtol=1e-13, atol=1e-13)
+
+
+def test_restore_strict_keeps_process_count_contract(tmp_path):
+    path, _ = _save_222(tmp_path)
+    igg.init_global_grid(5, 14, 8, dimx=4, dimy=1, dimz=2, quiet=True)
+    # strict: topology differs -> the exact-topology error, not a reshard
+    with pytest.raises(ValueError, match="different grid topology"):
+        igg.restore_checkpoint(path, strict=True)
+
+
+# -- integrity: manifest, verification, generation fallback -------------------
+
+
+def _save_gens(tmp_path, steps=(2, 4)):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T, _ = _coord_state()
+    return [igg.save_checkpoint(tmp_path, (T,), s) for s in steps]
+
+
+def test_manifest_records_every_shard_crc(tmp_path):
+    (path,) = _save_gens(tmp_path, steps=(3,))
+    meta = ckpt.checkpoint_meta(path)
+    assert meta["format"] == ckpt.FORMAT_VERSION
+    assert set(meta["shards"]) == {"shards_p0.npz"}
+    rec = meta["shards"]["shards_p0.npz"]
+    shard = os.path.join(path, "shards_p0.npz")
+    assert rec["bytes"] == os.path.getsize(shard)
+    assert rec["crc32"] == ckpt._crc32_file(shard)
+    assert igg.verify_checkpoint(path) is None
+    # no staging remnants: the tmp dir was renamed away, sidecars removed
+    assert [n for n in os.listdir(os.path.dirname(path)) if n.startswith(".")] == []
+    assert not [n for n in os.listdir(path) if n.endswith(".crc.json")]
+
+
+def test_verify_detects_corruption_and_truncation(tmp_path):
+    (path,) = _save_gens(tmp_path, steps=(3,))
+    shard = os.path.join(path, "shards_p0.npz")
+    size = os.path.getsize(shard)
+    with open(shard, "r+b") as f:  # flip one byte mid-file
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert "corrupt" in igg.verify_checkpoint(path)
+    with pytest.raises(ValueError, match="integrity"):
+        igg.restore_checkpoint(path)
+    os.truncate(shard, size // 2)
+    assert "truncated" in igg.verify_checkpoint(path)
+    os.remove(shard)
+    assert "missing" in igg.verify_checkpoint(path)
+
+
+def test_latest_checkpoint_falls_back_to_newest_valid(tmp_path, capfd):
+    p2, p4 = _save_gens(tmp_path)
+    assert igg.latest_checkpoint(tmp_path) == p4
+    shard = os.path.join(p4, "shards_p0.npz")
+    os.truncate(shard, os.path.getsize(shard) // 2)
+    # generation-by-generation fallback: newest is damaged -> previous wins
+    assert igg.latest_checkpoint(tmp_path) == p2
+    assert "skipping invalid checkpoint" in capfd.readouterr().err
+    # unverified scan still reports the newest published generation
+    assert igg.latest_checkpoint(tmp_path, verify=False) == p4
+    # both generations damaged -> None
+    shard2 = os.path.join(p2, "shards_p0.npz")
+    os.truncate(shard2, os.path.getsize(shard2) // 2)
+    assert igg.latest_checkpoint(tmp_path) is None
+
+
+def test_legacy_format1_checkpoint_still_restores(tmp_path):
+    """A pre-manifest (format 1) directory keeps its completion-marker
+    semantics: verification passes on the marker alone and restore works."""
+    (path,) = _save_gens(tmp_path, steps=(3,))
+    meta_path = os.path.join(path, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["format"] = 1
+    del meta["shards"]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    assert igg.verify_checkpoint(path) is None
+    (T,), step, _ = igg.restore_checkpoint(path)
+    assert step == 3
+
+
+def test_fault_injected_ckpt_corrupt_proves_fallback(tmp_path, clean_env, fault_injection):
+    """The in-tree drill: ckpt_corrupt damages the step-4 generation right
+    after it publishes; a resumed run must fall back to step 2 and still
+    finish bit-identical to the fault-free oracle."""
+    fault_injection("ckpt_corrupt:step4")
+    diffusion3d.run(4, NX, NX, NX, checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True)
+    p4 = os.path.join(str(tmp_path), "step_00000004")
+    assert "corrupt" in igg.verify_checkpoint(p4)
+    assert igg.latest_checkpoint(tmp_path).endswith("step_00000002")
+    res.reset_fault_injector()
+    os.environ.pop("IGG_FAULT_INJECT", None)
+    T_res = diffusion3d.run(6, NX, NX, NX, checkpoint_every=2, checkpoint_dir=tmp_path, quiet=True)
+    T_full = diffusion3d.run(6, NX, NX, NX, quiet=True)
+    np.testing.assert_array_equal(np.asarray(T_res), np.asarray(T_full))
+
+
+def test_fault_injected_ckpt_truncate(tmp_path, clean_env, fault_injection):
+    fault_injection("ckpt_truncate:step2")
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T, _ = _coord_state()
+    p2 = igg.save_checkpoint(tmp_path, (T,), 2)
+    assert "truncated" in igg.verify_checkpoint(p2)
+    # fires once: the next generation publishes intact
+    p4 = igg.save_checkpoint(tmp_path, (T,), 4)
+    assert igg.verify_checkpoint(p4) is None
+    assert igg.latest_checkpoint(tmp_path) == p4
+
+
+def test_fault_set_parses_comma_specs(clean_env):
+    fs = res.FaultSet.from_spec("worker_crash:step4:proc1,ckpt_corrupt:step4")
+    assert fs.active and len(fs.injectors) == 2
+    assert {i.kind for i in fs.injectors} == {"worker_crash", "ckpt_corrupt"}
+    assert not res.FaultSet.from_spec(None).active
+    with pytest.raises(ValueError, match="shard"):
+        res.FaultInjector.from_spec("ckpt_corrupt:step2:proc1")
+    inj = res.FaultInjector.from_spec("ckpt_truncate:step7:shard1")
+    assert (inj.kind, inj.step, inj.target) == ("ckpt_truncate", 7, 1)
+
+
+# -- retention ----------------------------------------------------------------
+
+
+def test_prune_refuses_to_delete_only_valid_generation(tmp_path):
+    p2, p4 = _save_gens(tmp_path)
+    shard = os.path.join(p4, "shards_p0.npz")
+    os.truncate(shard, os.path.getsize(shard) // 2)
+    # keep=1 would retain only the (damaged) newest: the only VALID
+    # generation (step 2) must survive the prune
+    removed = ckpt.prune_checkpoints(tmp_path, keep=1)
+    assert removed == []
+    assert igg.latest_checkpoint(tmp_path) == p2
+    # with protection off, retention is blind (the documented escape hatch)
+    removed = ckpt.prune_checkpoints(tmp_path, keep=1, protect_valid=False)
+    assert removed == [p2]
+    assert igg.latest_checkpoint(tmp_path) is None
+
+
+def test_runguard_checkpoint_keep_env_and_kwarg(tmp_path, clean_env, monkeypatch):
+    monkeypatch.setenv("IGG_CHECKPOINT_KEEP", "2")
+    g = res.RunGuard(checkpoint_every=1, checkpoint_dir=str(tmp_path))
+    assert g.checkpoint_keep == 2
+    g = res.RunGuard(checkpoint_every=1, checkpoint_dir=str(tmp_path), checkpoint_keep=3)
+    assert g.checkpoint_keep == 3
+    with pytest.raises(ValueError, match="checkpoint_keep"):
+        res.RunGuard(checkpoint_keep=-1)
+    # end to end through a model loop: only the newest 2 generations remain
+    monkeypatch.delenv("IGG_CHECKPOINT_KEEP")
+    diffusion3d.run(
+        6, NX, NX, NX, checkpoint_every=1, checkpoint_dir=tmp_path,
+        checkpoint_keep=2, quiet=True,
+    )
+    steps = [s for s, _ in ckpt.checkpoint_steps(tmp_path)]
+    assert steps == [5, 6]
+
+
+# -- gather(dedup=True): the shared block-assembly path ------------------------
+
+
+def test_gather_dedup_strips_overlaps():
+    igg.init_global_grid(NX, NX, NX, quiet=True)  # dims (2,2,2)
+    T, Vx = _coord_state()
+    dd = igg.gather(T, dedup=True)
+    assert dd.shape == (14, 14, 14)
+    # the de-dup array IS the global grid: coordinate-derived values match
+    # the analytic global coordinates at every cell
+    x = np.arange(14) * 0.37
+    y = np.arange(14) * 0.11
+    z = np.arange(14) * 0.53
+    X, Y, Z = np.meshgrid(x, y, z, indexing="ij")
+    np.testing.assert_allclose(dd, X * 1.3 + Y * 0.7 + Z * 0.11 + X * Y * 0.003,
+                               rtol=1e-13, atol=1e-13)
+    assert igg.gather(Vx, dedup=True).shape == (15, 14, 14)
+
+
+def test_gather_dedup_matches_chunked_path():
+    igg.init_global_grid(NX, NX, NX, periodz=1, quiet=True)
+    T, _ = _coord_state()
+    local = igg.gather(T, dedup=True)
+    assert local.shape == (14, 14, 12)
+    chunked = igg.gather(T, dedup=True, _force_chunked=True)
+    np.testing.assert_array_equal(local, chunked)
+    # fill-in-place signature takes the de-dup-sized buffer
+    buf = np.zeros_like(local)
+    assert igg.gather(T, buf, dedup=True, _force_chunked=True) is None
+    np.testing.assert_array_equal(buf, local)
+
+
+def test_owned_range_partitions_exactly():
+    # non-periodic: ranges tile [0, G) exactly once
+    for nb, s, ol in [(2, 8, 2), (4, 5, 2), (3, 9, 3), (1, 8, 2)]:
+        G = gather_mod.dedup_length(nb, s, ol, False)
+        cover = []
+        for c in range(nb):
+            a, b = gather_mod.owned_range(c, nb, s, ol, False)
+            cover += list(gather_mod.dedup_indices(c, a, b, s, ol, G))
+        assert sorted(cover) == list(range(G))
+    # periodic: same, with the wrap seam
+    for nb, s, ol in [(2, 8, 2), (4, 5, 2), (1, 8, 2)]:
+        G = gather_mod.dedup_length(nb, s, ol, True)
+        cover = []
+        for c in range(nb):
+            a, b = gather_mod.owned_range(c, nb, s, ol, True)
+            cover += list(gather_mod.dedup_indices(c, a, b, s, ol, G))
+        assert sorted(cover) == list(range(G))
+    with pytest.raises(ValueError, match="negative overlap"):
+        gather_mod.owned_range(0, 2, 4, -1, False)

@@ -265,6 +265,105 @@ def test_worker_crash_restart_from_checkpoint(tmp_path):
     shutil.rmtree(tmp_path / "ckpt_ref", ignore_errors=True)
 
 
+@pytest.mark.fault
+def test_elastic_restart_shrunk_topology(tmp_path):
+    """Crash + damaged newest generation -> shrunk-topology restart.
+
+    The elastic-restart acceptance path across a REAL process boundary
+    (docs/robustness.md): a 2-process gloo pair (dims (2,1,1), local 8^3,
+    nxyz_g (14,8,8)) runs with ``IGG_FAULT_INJECT=worker_crash:step4:proc1,
+    ckpt_corrupt:step4`` — process 1 dies right after the step-4 checkpoint
+    AND that newest generation is bit-flipped in place.  The restart runs on
+    ONE process (1 device, local (14,8,8) — the same implicit global grid),
+    where `latest_checkpoint` must fall back to the step-2 generation and
+    `restore_checkpoint` must reshard the 2-process shards onto the shrunk
+    topology.  The finished run must match a never-crashed single-grid
+    oracle of the same global problem (decomposition invariance).
+    """
+    worker = os.path.join(_here, "_resilience_worker.py")
+    env = _pair_env()
+    env["IGG_FAULT_INJECT"] = "worker_crash:step4:proc1,ckpt_corrupt:step4"
+    crash_dir = tmp_path / "ckpt_crash"
+    port = _free_port()
+    logdir = tmp_path / "logs_crash"
+    logdir.mkdir()
+    logs = [open(logdir / f"worker{pid}.log", "w+") for pid in range(2)]
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, worker, str(pid), "2", str(port),
+                "crash", str(crash_dir), str(tmp_path / "never.npy"),
+            ],
+            env=env,
+            stdout=logs[pid],
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    try:
+        procs[1].wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    # the survivor loses its peer mid-collective; reap it like a supervisor
+    try:
+        procs[0].wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        procs[0].wait()
+    for f in logs:
+        f.flush()
+        f.seek(0)
+    outs = [f.read() for f in logs]
+    for f in logs:
+        f.close()
+    assert procs[1].returncode == 17, (
+        f"worker 1 should have crashed with status 17, got "
+        f"{procs[1].returncode}:\n{outs[1]}"
+    )
+    assert "IGG_FAULT_INJECT(ckpt_corrupt)" in outs[0], outs[0]
+
+    from implicitglobalgrid_tpu.utils.checkpoint import latest_checkpoint
+
+    # the newest published generation is step 4, but it is damaged: the
+    # verified scan must fall back to step 2
+    newest = latest_checkpoint(crash_dir, verify=False)
+    assert newest is not None and newest.endswith("step_00000004"), newest
+    latest = latest_checkpoint(crash_dir)
+    assert latest is not None and latest.endswith("step_00000002"), latest
+
+    # never-crashed oracle: the same global problem on ONE device
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.utils import resilience
+
+    def single_grid_run(ckptdir):
+        igg.init_global_grid(14, 8, 8, quiet=True, devices=jax.devices()[:1])
+        assert igg.get_global_grid().nxyz_g == (14, 8, 8)
+        state, params = diffusion3d.setup(14, 8, 8, init_grid=False)
+        step = diffusion3d.make_step(params)
+        guard = resilience.RunGuard(
+            checkpoint_every=2 if ckptdir else None,
+            checkpoint_dir=ckptdir,
+            names=("T", "Cp"),
+        )
+        state = resilience.guarded_time_loop(
+            step, state, 6, guard=guard, sync_every_step=True
+        )
+        T = np.asarray(jax.block_until_ready(state[0]))
+        igg.finalize_global_grid()
+        return T
+
+    oracle = single_grid_run(None)
+    # shrunk-topology restart: resumes at step 2 (elastic reshard of the
+    # 2-process shards), finishes the remaining 4 steps on 1 process
+    got = single_grid_run(str(crash_dir))
+    assert got.shape == oracle.shape
+    np.testing.assert_allclose(got, oracle, rtol=1e-13, atol=1e-13)
+
+
 def test_gather_invalid_root_raises():
     import implicitglobalgrid_tpu as igg
 

@@ -405,13 +405,19 @@ def test_checkpoint_prune_keeps_newest(tmp_path):
 
 
 def test_restore_rejects_topology_mismatch(tmp_path):
+    # Same local sizes under different dims imply a DIFFERENT global grid —
+    # inadmissible even elastically; strict=True keeps the exact-topology
+    # contract and its error (the admissible-reshard cases live in
+    # tests/test_checkpoint_elastic.py).
     igg.init_global_grid(NX, NX, NX, quiet=True)  # dims (2,2,2)
     T = igg.ones((NX, NX, NX))
     path = igg.save_checkpoint(tmp_path, (T,), 3)
     igg.finalize_global_grid()
     igg.init_global_grid(NX, NX, NX, dimx=4, dimy=2, dimz=1, quiet=True)
-    with pytest.raises(ValueError, match="different grid topology"):
+    with pytest.raises(ValueError, match="cannot be elastically restored"):
         igg.restore_checkpoint(path)
+    with pytest.raises(ValueError, match="different grid topology"):
+        igg.restore_checkpoint(path, strict=True)
 
 
 def test_restore_rejects_wrong_overlap(tmp_path):
