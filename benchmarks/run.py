@@ -213,6 +213,14 @@ def _emit(name, teff, t_it, extra=None, emit=True):
     }
     if extra:
         rec.update(extra)
+    # Fold every emitted measurement into the process telemetry registry
+    # (docs/observability.md): the driver's final snapshot then carries the
+    # same numbers the JSON lines do — one source of truth for collectors.
+    from implicitglobalgrid_tpu.utils import telemetry as _telemetry
+
+    _telemetry.gauge(f"bench.{name}.teff_gbs").set(teff)
+    _telemetry.histogram("bench.teff_gbs").record(teff)
+    _telemetry.histogram("bench.t_it_s").record(t_it)
     if emit:
         print(json.dumps(rec), flush=True)
     return rec

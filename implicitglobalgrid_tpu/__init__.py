@@ -29,6 +29,14 @@ Production resilience (docs/robustness.md): guarded multi-host bring-up
 `RunGuard`), and per-process checkpoint/restart (`save_checkpoint` /
 `restore_checkpoint` / `latest_checkpoint`) with an `IGG_FAULT_INJECT`
 harness proving the recovery paths.
+
+Observability (docs/observability.md): a process-local metrics registry +
+per-process JSONL event log (`utils.telemetry`), per-step wall-time /
+steps-per-s / ``T_eff`` instrumentation in every model's run loop, named
+profiler annotations on the pipelined ring/interior passes and the slab
+exchange, and `telemetry_snapshot` / `dump_metrics` (JSON + Prometheus
+text) as the public surface.  ``IGG_TELEMETRY=0`` disables it all on a
+zero-allocation branch.
 """
 
 from .parallel.grid import (
@@ -74,6 +82,8 @@ from .utils.checkpoint import (
     save_checkpoint,
     verify_checkpoint,
 )
+from .utils import telemetry
+from .utils.telemetry import dump_metrics, telemetry_snapshot
 
 __version__ = "0.1.0"
 
@@ -128,4 +138,8 @@ __all__ = [
     "latest_checkpoint",
     "verify_checkpoint",
     "prune_checkpoints",
+    # observability subsystem (docs/observability.md)
+    "telemetry",
+    "telemetry_snapshot",
+    "dump_metrics",
 ]

@@ -84,9 +84,18 @@ def run_pipelined_group_schedule(chunks, boundary, interior, carry, *,
     excess) is `run_group_schedule`'s.
     """
 
+    from ..utils.compat import named_scope
+
     def group(ki, c):
-        b_out, pend = boundary(ki, c)
-        return interior(ki, c, b_out, pend)
+        # Named profiler scopes (docs/observability.md): the ring pass (and
+        # the early slab-exchange dispatch it feeds) vs the interior pass
+        # show up as distinctly named op groups in a `profile_trace`
+        # capture — the runtime evidence that the collectives overlap the
+        # interior launch, by name in Perfetto.
+        with named_scope("igg_ring_pass"):
+            b_out, pend = boundary(ki, c)
+        with named_scope("igg_interior_pass"):
+            return interior(ki, c, b_out, pend)
 
     return run_group_schedule(
         chunks, group, carry,

@@ -657,8 +657,13 @@ def run(
             names=("P", "Vx", "Vy", "Vz"),
         )
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+        # Telemetry bytes model: all four leapfrog fields (P, Vx, Vy, Vz)
+        # evolve, so each must stream once in and once out per step.
+        from ..utils.telemetry import teff_bytes
+
         state = guarded_time_loop(
-            step, state, nt, guard=guard, sync_every_step=sync_every_step
+            step, state, nt, guard=guard, sync_every_step=sync_every_step,
+            model="acoustic3d", bytes_per_step=teff_bytes(state),
         )
         P = jax.block_until_ready(state[0])
     except BaseException:

@@ -683,8 +683,14 @@ def run(
         # too many asynchronously dispatched programs pile up; syncing each
         # step costs nothing there and is skipped on real accelerators.
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+        # Telemetry bytes model (docs/observability.md): the diffusion step
+        # MUST stream T once in and once out; Cp is a read-only parameter
+        # field and does not count (the reference T_eff convention).
+        from ..utils.telemetry import teff_bytes
+
         state = guarded_time_loop(
-            step, state, nt, guard=guard, sync_every_step=sync_every_step
+            step, state, nt, guard=guard, sync_every_step=sync_every_step,
+            model="diffusion3d", bytes_per_step=teff_bytes(state[:1]),
         )
         T = jax.block_until_ready(state[0])
     except BaseException:

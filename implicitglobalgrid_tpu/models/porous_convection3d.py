@@ -883,8 +883,14 @@ def run(
             names=("T", "Pf", "qDx", "qDy", "qDz"),
         )
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"
+        # Telemetry bytes model: the whole evolving state (T, Pf, qDx, qDy,
+        # qDz) streams per time step; the inner PT iterations move more on
+        # top, so the recorded T_eff stays a lower bound (docs convention).
+        from ..utils.telemetry import teff_bytes
+
         state = guarded_time_loop(
-            step, state, nt, guard=guard, sync_every_step=sync_every_step
+            step, state, nt, guard=guard, sync_every_step=sync_every_step,
+            model="porous_convection3d", bytes_per_step=teff_bytes(state),
         )
         T = jax.block_until_ready(state[0])
     except BaseException:

@@ -46,7 +46,28 @@ _fetch_cache: dict = {}
 #: never hold the assembled array).  Set by every `gather` call:
 #: ``path`` in {"local", "chunked"}, ``host_bytes`` = bytes this process
 #: fetched to host memory, ``fetches`` = number of per-block collectives.
+#: Compat alias of the telemetry registry (``gather.*`` metrics,
+#: docs/observability.md): treat it as a READ-ONLY view of the LAST call —
+#: it is reset to ``None`` at the START of every gather, so a failed gather
+#: can never leave the previous call's stats lying around.
 last_gather_stats: dict | None = None
+
+
+def _record_stats(stats: dict) -> None:
+    """Publish one gather's stats: the compat global + the registry fold."""
+    global last_gather_stats
+    last_gather_stats = stats
+    from ..utils import telemetry as _telemetry
+
+    if not _telemetry.enabled():
+        return
+    _telemetry.counter("gather.calls").inc()
+    _telemetry.counter(f"gather.calls.{stats['path']}").inc()
+    _telemetry.counter("gather.fetches").inc(stats.get("fetches", 0))
+    _telemetry.counter("gather.host_bytes").inc(stats.get("host_bytes", 0))
+    _telemetry.histogram("gather.call_host_bytes").record(
+        stats.get("host_bytes", 0)
+    )
 
 
 def _clear_caches() -> None:
@@ -138,9 +159,9 @@ def _gather_batch_size() -> int:
     (min 1); the default 8 keeps the root transient below one typical
     block-row.
     """
-    from ..utils.config import _int_env
+    from ..utils.config import gather_batch_env
 
-    val = _int_env("IGG_GATHER_BATCH")
+    val = gather_batch_env()
     return max(int(val), 1) if val is not None else 8
 
 
@@ -155,7 +176,6 @@ def _gather_chunked(A, gg, out: np.ndarray | None, dedup: bool = False):
     """
     import jax
 
-    global last_gather_stats
     ndim = A.ndim
     bshape = _local_shape(A, gg)
     dims = gg.dims[:ndim]
@@ -204,14 +224,16 @@ def _gather_chunked(A, gg, out: np.ndarray | None, dedup: bool = False):
             del data
         del blk
         nfetch += 1
-    last_gather_stats = {
-        "path": "chunked",
-        "host_bytes": host_bytes,
-        "fetches": nfetch,
-        "blocks": len(idxs),
-        "batch": batch,
-        "block_bytes": int(np.prod(bshape)) * np.dtype(A.dtype).itemsize,
-    }
+    _record_stats(
+        {
+            "path": "chunked",
+            "host_bytes": host_bytes,
+            "fetches": nfetch,
+            "blocks": len(idxs),
+            "batch": batch,
+            "block_bytes": int(np.prod(bshape)) * np.dtype(A.dtype).itemsize,
+        }
+    )
     return out
 
 
@@ -364,7 +386,11 @@ def gather(
 
     _grid.check_initialized()
     gg = _grid.global_grid()
+    # Reset FIRST: a gather that fails (or deadlocks and is restarted) must
+    # not leave the previous call's stats lying around as if they were its
+    # own — `last_gather_stats` is only ever the LAST COMPLETED call's view.
     global last_gather_stats
+    last_gather_stats = None
     if not (0 <= root < jax.process_count()):
         # Reference tests gather with non-default roots
         # (`/root/reference/test/test_gather.jl:126-137`); an out-of-range
@@ -417,12 +443,14 @@ def gather(
         return out
 
     data = np.asarray(jax.device_get(A))
-    last_gather_stats = {
-        "path": "local",
-        "host_bytes": data.nbytes,
-        "fetches": 0,
-        "block_bytes": data.nbytes,
-    }
+    _record_stats(
+        {
+            "path": "local",
+            "host_bytes": data.nbytes,
+            "fetches": 0,
+            "block_bytes": data.nbytes,
+        }
+    )
     if not is_root:
         return None
     if dedup:

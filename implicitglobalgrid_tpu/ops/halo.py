@@ -423,10 +423,13 @@ def _permute_slabs(gg, d: int, *, send_lo, send_hi, keep_lo, keep_hi):
 
 def _update_halo_local(fields: tuple, gg, width: int = 1) -> tuple:
     """Per-block exchange of all fields, dimensions strictly in order x→y→z."""
+    from ..utils.compat import named_scope
+
     out = list(fields)
-    for d in range(NDIMS):
-        for i in range(len(out)):
-            out[i] = _exchange_dim(out[i], d, gg, width)
+    with named_scope("igg_halo_exchange"):
+        for d in range(NDIMS):
+            for i in range(len(out)):
+                out[i] = _exchange_dim(out[i], d, gg, width)
     return tuple(out)
 
 
@@ -606,22 +609,30 @@ def begin_slab_exchange(fields, dims, *, width: int, logicals=None):
     ``logicals``: per-field REAL shapes for padded layouts (as in
     `_exchange_dim`).  Traced-context only, like `exchange_dims`.
     """
+    from ..utils import telemetry as _telemetry
+    from ..utils.compat import named_scope
+
     gg = _grid.global_grid()
     if logicals is None:
         logicals = (None,) * len(fields)
+    # Trace-time counter: begin/finish calls run while BUILDING a program
+    # (the early-dispatch exchange shape), so this counts traced schedules,
+    # not runtime executions (docs/observability.md).
+    _telemetry.counter("halo.begin_slab_traces").inc()
     pends = []
-    for A, logical in zip(fields, logicals):
-        received: dict = {}
-        pend = []
-        for d in dims:
-            vals = _slab_recv_values(
-                A, d, gg, width, logical, received=received
-            )
-            if vals is None:
-                continue
-            received[d] = vals
-            pend.append((d, vals[0], vals[1]))
-        pends.append(pend)
+    with named_scope("igg_slab_exchange_begin"):
+        for A, logical in zip(fields, logicals):
+            received: dict = {}
+            pend = []
+            for d in dims:
+                vals = _slab_recv_values(
+                    A, d, gg, width, logical, received=received
+                )
+                if vals is None:
+                    continue
+                received[d] = vals
+                pend.append((d, vals[0], vals[1]))
+            pends.append(pend)
     return pends
 
 
@@ -633,16 +644,21 @@ def finish_slab_exchange(fields, pends, *, logicals=None):
     output) as long as they hold the same owned values.  Returns the
     updated tuple.
     """
+    from ..utils import telemetry as _telemetry
+    from ..utils.compat import named_scope
+
     if logicals is None:
         logicals = (None,) * len(fields)
+    _telemetry.counter("halo.finish_slab_traces").inc()
     out = []
-    for A, pend, logical in zip(fields, pends, logicals):
-        shp = logical if logical is not None else tuple(A.shape)
-        for d, lo, hi in pend:
-            w = lo.shape[d]
-            A = _set_plane(A, hi, shp[d] - w, d)
-            A = _set_plane(A, lo, 0, d)
-        out.append(A)
+    with named_scope("igg_slab_exchange_finish"):
+        for A, pend, logical in zip(fields, pends, logicals):
+            shp = logical if logical is not None else tuple(A.shape)
+            for d, lo, hi in pend:
+                w = lo.shape[d]
+                A = _set_plane(A, hi, shp[d] - w, d)
+                A = _set_plane(A, lo, 0, d)
+            out.append(A)
     return tuple(out)
 
 
@@ -860,6 +876,31 @@ def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1, dims=None):
     return tuple(out)
 
 
+def _exchange_slab_bytes(fields, gg, width: int) -> int:
+    """Per-call slab traffic of a global-array exchange, in bytes.
+
+    For every field and every dimension that actually exchanges, two
+    ``width``-deep slabs (one per side) are written into the halo planes —
+    ``2 * width * plane_bytes`` per field per active dim.  Host-side shape
+    math only (no device work); self-copies count (they move the same
+    bytes), PROC_NULL keep-old planes of edge blocks are included (the
+    per-block census is not knowable host-side without extra collectives),
+    so this is the upper-bound slab payload the program was built to move.
+    """
+    total = 0
+    for A in fields:
+        shp = local_shape(A, gg)
+        itemsize = np.dtype(A.dtype).itemsize
+        n = int(np.prod(shp))
+        for d in range(min(len(shp), NDIMS)):
+            if not dim_has_halo_activity(gg, d):
+                continue
+            if ol(d, shape=shp, gg=gg) < 2:
+                continue
+            total += 2 * width * (n // shp[d]) * itemsize
+    return total
+
+
 def _default_donate() -> bool:
     """``IGG_DONATE`` env default for `update_halo`'s global-array entry.
 
@@ -963,6 +1004,16 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None):
         sig = tuple((local_shape(A, gg), str(A.dtype)) for A in arrs)
         if donate is None:
             donate = _default_donate()
+        from ..utils import telemetry as _telemetry
+
+        if _telemetry.enabled():
+            # Runtime counters (the global-array entry runs host-side per
+            # call, unlike the traced paths — docs/observability.md).
+            nbytes = _exchange_slab_bytes(arrs, gg, width)
+            _telemetry.counter("halo.exchanges").inc()
+            _telemetry.counter("halo.fields").inc(len(arrs))
+            _telemetry.counter("halo.bytes").inc(nbytes)
+            _telemetry.histogram("halo.slab_bytes").record(nbytes)
         out = _global_update_fn(gg, sig, width, bool(donate))(*arrs)
         if _post_exchange_hook is not None:
             out = tuple(_post_exchange_hook(tuple(out)))

@@ -77,6 +77,13 @@ def init_distributed(
     if timeout_s <= 0:
         raise ValueError(f"timeout_s must be > 0 (got {timeout_s})")
     injector = _resilience.get_fault_injector()
+    if process_id is not None:
+        # Bring-up events (retries, injected flakes) fire before the runtime
+        # can answer jax.process_index(); stage the known rank so they are
+        # tagged (and filed) correctly instead of all claiming rank 0.
+        from ..utils import telemetry as _telemetry
+
+        _telemetry.set_rank_hint(process_id)
 
     def attempt():
         injector.maybe_flake_init()  # IGG_FAULT_INJECT=init_flake:N harness

@@ -388,7 +388,9 @@ def _select_device():
 
 # -- Timing tools (reference: src/tools.jl:230-236) --------------------------
 
-_t0: list[float] = [0.0]
+# None = no user tic() yet: toc() must raise instead of measuring from an
+# arbitrary epoch (init_timing_functions primes the barrier but resets this).
+_t0: list[float | None] = [None]
 _barrier_fn = None
 
 
@@ -420,24 +422,38 @@ def _barrier() -> None:
 
 
 def tic() -> None:
-    """Start the chronometer once all devices have reached this point."""
+    """Start the chronometer once all devices have reached this point.
+
+    Monotonic (`time.perf_counter`): a wall-clock (`time.time`) chronometer
+    jumps with NTP slews/steps, which at multi-minute production timings is
+    a real error source — and the reference's own contract is pure elapsed
+    time, not timestamps.
+    """
     check_initialized()
     _barrier()
-    _t0[0] = time.time()
+    _t0[0] = time.perf_counter()
 
 
 def toc() -> float:
     """Elapsed seconds since `tic` once all devices have reached this point."""
     check_initialized()
+    if _t0[0] is None:
+        raise RuntimeError(
+            "toc() called before tic(): the chronometer was never started "
+            "(call igg.tic() at the start of the timed section)."
+        )
     _barrier()
-    return time.time() - _t0[0]
+    return time.perf_counter() - _t0[0]
 
 
 def init_timing_functions() -> None:
     # Pre-compile the barrier so the first user tic()/toc() is fast
-    # (reference: src/init_global_grid.jl:97,102-105).
+    # (reference: src/init_global_grid.jl:97,102-105) — then reset the
+    # chronometer: the priming tic must not masquerade as a user tic (a
+    # user's toc()-without-tic() would silently time since init).
     tic()
     toc()
+    _t0[0] = None
 
 
 @_contextlib.contextmanager

@@ -60,6 +60,7 @@ import numpy as np
 
 from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES
+from . import telemetry as _telemetry
 
 FORMAT_VERSION = 2
 #: formats this build can restore (1 = pre-manifest, no integrity data)
@@ -255,6 +256,14 @@ def save_checkpoint(
     # process — without it a non-root caller could verify/restore the path
     # before process 0's rename lands.
     _dist.sync_all_processes()
+    _telemetry.event(
+        "checkpoint.saved",
+        step=step,
+        path=step_dir,
+        shard_bytes=sidecar["bytes"],
+    )
+    _telemetry.counter("checkpoint.saves").inc()
+    _telemetry.counter("checkpoint.shard_bytes").inc(sidecar["bytes"])
     return step_dir
 
 
@@ -343,6 +352,8 @@ def latest_checkpoint(
         problem = verify_checkpoint(path)
         if problem is None:
             return path
+        _telemetry.event("checkpoint.fallback", path=path, problem=problem)
+        _telemetry.counter("checkpoint.fallbacks").inc()
         print(
             f"[igg.checkpoint] skipping invalid checkpoint {path}: {problem} "
             f"(falling back to the previous generation)",
@@ -404,6 +415,9 @@ def restore_checkpoint(
     if verify:
         problem = verify_checkpoint(path)
         if problem is not None:
+            _telemetry.event(
+                "checkpoint.verify_failed", path=path, problem=problem
+            )
             raise ValueError(
                 f"Checkpoint {path!r} failed integrity verification: "
                 f"{problem}. Use latest_checkpoint() to fall back to the "
@@ -499,6 +513,13 @@ def _restore_same_topology(path, meta, gg, like):
             return npz[key].view(dtype).reshape(shape)
 
         state.append(jax.make_array_from_callback(gshape, sharding, lookup))
+    _telemetry.event(
+        "checkpoint.restore",
+        mode="same_topology",
+        step=int(meta["step"]),
+        path=path,
+    )
+    _telemetry.counter("checkpoint.restores").inc()
     return tuple(state), int(meta["step"]), meta.get("extra", {})
 
 
@@ -667,6 +688,18 @@ def _restore_elastic(path, meta, gg, like):
 
         state.append(jax.make_array_from_callback(new_gshape, sharding, lookup))
         del glob
+    # The RESHARD marker of the failover timeline: a restore that crossed
+    # topologies (different dims / process count / device layout).
+    _telemetry.event(
+        "checkpoint.restore",
+        mode="elastic",
+        step=int(meta["step"]),
+        path=path,
+        saved_dims=list(saved_grid["dims"]),
+        current_dims=list(gg.dims),
+    )
+    _telemetry.counter("checkpoint.restores").inc()
+    _telemetry.counter("checkpoint.elastic_restores").inc()
     return tuple(state), int(meta["step"]), meta.get("extra", {})
 
 
@@ -709,4 +742,7 @@ def prune_checkpoints(
     for _, path in doomed:
         shutil.rmtree(path, ignore_errors=True)
         removed.append(path)
+    if removed:
+        _telemetry.event("checkpoint.prune", removed=removed, keep=keep)
+        _telemetry.counter("checkpoint.prunes").inc(len(removed))
     return removed

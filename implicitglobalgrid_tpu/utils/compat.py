@@ -67,6 +67,43 @@ def pallas_interpret_active() -> bool:
     return _pallas_interpret
 
 
+def named_scope(name: str):
+    """`jax.named_scope` across JAX versions (no-op where absent).
+
+    The device-side profiler annotation: names entered here land in the
+    XLA op metadata (``metadata={op_name="...igg_ring_pass..."}``) of every
+    op traced inside the scope, so a `profile_trace` capture shows the
+    pipelined ring/interior/exchange phases BY NAME in Perfetto — and the
+    compiled HLO text carries them too, which is what the toolchain-
+    independent test asserts (`tests/test_telemetry.py`).
+    """
+    import jax
+
+    ns = getattr(jax, "named_scope", None)
+    if ns is None:  # pragma: no cover - every supported JAX ships it
+        return contextlib.nullcontext()
+    return ns(name)
+
+
+def trace_annotation(name: str):
+    """`jax.profiler.TraceAnnotation` across JAX versions (no-op fallback).
+
+    The HOST-side profiler annotation: names the enclosing wall-clock span
+    on the Python-thread track of a `profile_trace` capture (dispatch,
+    guard probes, checkpoint I/O).  Complements `named_scope`, which names
+    the *device* ops.
+    """
+    try:
+        import jax
+
+        cls = getattr(jax.profiler, "TraceAnnotation", None)
+        if cls is not None:
+            return cls(name)
+    except Exception:  # pragma: no cover - profiler machinery absent
+        pass
+    return contextlib.nullcontext()
+
+
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """`jax.shard_map` across JAX versions.
 

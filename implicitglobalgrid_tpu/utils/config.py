@@ -55,6 +55,21 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           | ``ckpt_corrupt:stepN[:shardS]``
                           | ``ckpt_truncate:stepN[:shardS]``; several faults
                           compose comma-separated (docs/robustness.md)
+
+``IGG_GATHER_BATCH``      blocks fetched per compiled dispatch in the
+                          multi-host gather (int, clamped to >= 1, default
+                          8; `ops.gather._gather_batch_size`)
+``IGG_TELEMETRY``         telemetry master switch (``0`` disables the
+                          metrics registry, the event log and every
+                          instrumented hot path to their zero-allocation
+                          no-op branch; unset/nonzero = on) — read per
+                          call by `utils.telemetry` (docs/observability.md)
+``IGG_TELEMETRY_DIR``     directory for the per-process JSONL event log
+                          (``events.jsonl`` / ``events.pN.jsonl``); unset =
+                          metrics-registry-only, no files written
+``IGG_HEARTBEAT_EVERY``   rank-0 heartbeat cadence in steps for the models'
+                          instrumented run loops (int >= 0; 0/unset = off):
+                          every N steps print step time, steps/s and T_eff
 ========================  ====================================================
 
 Explicit kwargs always win over env values; env values win over built-in
@@ -237,3 +252,34 @@ def fault_inject_env() -> str | None:
     """``IGG_FAULT_INJECT``: raw fault spec (parsed by `utils.resilience`)."""
     val = os.environ.get("IGG_FAULT_INJECT")
     return val or None
+
+
+def gather_batch_env() -> int | None:
+    """``IGG_GATHER_BATCH``: blocks per compiled gather dispatch.
+
+    Clamped (not rejected) to >= 1 by the consumer, matching the original
+    `ops.gather` behavior for 0/negative values.
+    """
+    return _int_env("IGG_GATHER_BATCH")
+
+
+# -- Telemetry knobs (read per call; docs/observability.md) -------------------
+
+
+def telemetry_enabled_env() -> bool:
+    """``IGG_TELEMETRY``: master switch for `utils.telemetry` (default ON;
+    ``0`` routes every instrumented hot path to its no-op branch)."""
+    val = _int_env("IGG_TELEMETRY")
+    return True if val is None else val > 0
+
+
+def telemetry_dir_env() -> str | None:
+    """``IGG_TELEMETRY_DIR``: event-log directory (unset = no files)."""
+    val = os.environ.get("IGG_TELEMETRY_DIR")
+    return val or None
+
+
+def heartbeat_every_env() -> int | None:
+    """``IGG_HEARTBEAT_EVERY``: rank-0 heartbeat cadence in steps (>= 0;
+    0 = off)."""
+    return _int_env("IGG_HEARTBEAT_EVERY", minimum=0)

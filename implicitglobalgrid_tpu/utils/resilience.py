@@ -46,6 +46,7 @@ import numpy as np
 from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES, NDIMS
 from . import config as _config
+from . import telemetry as _telemetry
 
 __all__ = [
     "GuardError",
@@ -167,6 +168,17 @@ def retry_call(
                     f"(timeout_s={timeout_s}, IGG_INIT_TIMEOUT_S) leaves no "
                     f"room for another retry. Last error: {e!r}"
                 ) from e
+            # Machine-readable retry record (docs/observability.md): the
+            # soak/ops timeline needs every bring-up retry, not just stderr.
+            _telemetry.event(
+                "retry",
+                what=describe,
+                attempt=attempt + 1,
+                of=retries + 1,
+                delay_s=delay,
+                error=repr(e),
+            )
+            _telemetry.counter("resilience.retries").inc()
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             else:
@@ -241,10 +253,25 @@ def watchdog(timeout_s: float | None, *, exit: bool = False, file=None):
         yield
         return
     arm_watchdog(timeout_s, exit=exit, file=file)
+    t0 = time.monotonic()
     try:
         yield
     finally:
         disarm_watchdog()
+        elapsed = time.monotonic() - t0
+        if elapsed > timeout_s:
+            # The block outlived this watchdog's deadline — the closest
+            # observable proxy for "the dump fired" (faulthandler cannot
+            # call back into Python).  NOT a guarantee: nested scopes
+            # re-arm the one process-wide timer (`_rearm`), so the timer
+            # may never have run `timeout_s` continuously; the stderr dump
+            # is the ground truth, this event is the timeline marker.
+            _telemetry.event(
+                "watchdog.deadline_exceeded",
+                timeout_s=timeout_s,
+                elapsed_s=elapsed,
+            )
+            _telemetry.counter("resilience.watchdog_deadline_exceeded").inc()
 
 
 # -- Numerical guards ---------------------------------------------------------
@@ -530,6 +557,7 @@ class FaultInjector:
         """Raise a simulated coordinator race while flaky attempts remain."""
         if self.kind == "init_flake" and self.count > 0:
             self.count -= 1
+            _telemetry.event("fault.init_flake", remaining=self.count)
             raise RuntimeError(
                 "IGG_FAULT_INJECT(init_flake): simulated coordinator race "
                 f"({self.count} flaky attempt(s) remaining)"
@@ -554,6 +582,11 @@ class FaultInjector:
         import jax.numpy as jnp
 
         idx = _block_interior_index(A, self.target or 0)
+        _telemetry.event(
+            "fault.halo_corrupt",
+            index=list(int(i) for i in idx),
+            block=self.target or 0,
+        )
         if _safe_process_index() == 0:
             at = "" if announce_step is None else f" after step {announce_step}"
             print(
@@ -587,6 +620,12 @@ class FaultInjector:
         if _safe_process_index() != want:
             return
         self.fired = True
+        # The event line is a single O_APPEND os.write — it survives the
+        # os._exit below, which is exactly what the failover drill's
+        # machine-readable timeline needs (the crash marker).
+        _telemetry.event(
+            "fault.worker_crash", step=step, status=self.CRASH_STATUS
+        )
         print(
             f"[igg.resilience] IGG_FAULT_INJECT(worker_crash): exiting hard "
             f"after step {step} (status {self.CRASH_STATUS})",
@@ -628,6 +667,9 @@ class FaultInjector:
                 f.seek(size // 2)
                 f.write(bytes([byte[0] ^ 0xFF]))
             what = f"flipped byte at offset {size // 2}"
+        _telemetry.event(
+            f"fault.{self.kind}", step=step, shard=self.target or 0, what=what
+        )
         print(
             f"[igg.resilience] IGG_FAULT_INJECT({self.kind}): {what} in "
             f"{shard} after step {step}",
@@ -776,7 +818,10 @@ def snapshot_state(state: tuple) -> tuple:
     return tuple(_copy_jit(A) for A in state)
 
 
-def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard", sync_every_step: bool = False) -> tuple:
+def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard",
+                      sync_every_step: bool = False,
+                      model: str | None = None,
+                      bytes_per_step: int | None = None) -> tuple:
     """The models' host-side time loop with the guard pipeline attached.
 
     Resumes from the guard's checkpoint dir when one exists, then advances
@@ -784,11 +829,30 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard", sync
     injection → NaN/Inf guard → checkpoint → crash injection; rollback may
     rewind the loop variable).  Shared by the three models' ``run()`` so the
     guard semantics cannot drift between them.
+
+    ``model`` switches on the per-step telemetry (docs/observability.md):
+    wall time, steps/s and — with ``bytes_per_step`` (the solver's
+    must-stream bytes model, `telemetry.teff_bytes`) — the built-in
+    ``T_eff`` histogram, plus the rank-0 ``IGG_HEARTBEAT_EVERY`` heartbeat.
+    Per-step wall time is the LOOP iteration's host time (dispatch + sync +
+    guard pipeline), exact when each step synchronizes and amortized-only
+    otherwise.  With ``IGG_TELEMETRY=0`` (or ``model=None``) the loop takes
+    the zero-allocation branch: one ``is not None`` check per step.
     """
     import jax
 
+    from .compat import trace_annotation
+
     state, it = guard.start(state)
     enabled = guard.enabled  # skip the per-step pipeline entirely when idle
+    tele = (
+        _telemetry.step_loop(
+            model, bytes_per_step=bytes_per_step, start_step=it,
+            total_steps=nt,
+        )
+        if model is not None
+        else None
+    )
     if it > nt:
         # A checkpoint past the requested horizon is almost always a stale
         # directory (e.g. a previous longer run) — returning it silently
@@ -801,12 +865,20 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard", sync
             stacklevel=2,
         )
     while it < nt:
-        state = step_fn(*state)
+        if tele is None:
+            state = step_fn(*state)
+        else:
+            with trace_annotation(f"igg_step[{model}]"):
+                state = step_fn(*state)
         if sync_every_step:
             jax.block_until_ready(state)
         it += 1
         if enabled:
             state, it = guard.on_step(state, it)
+        if tele is not None:
+            tele.on_step(it)
+    if tele is not None:
+        tele.finish(it)
     return state
 
 
@@ -922,6 +994,8 @@ class RunGuard:
                 state, it, _ = _ckpt.restore_checkpoint(
                     latest, like=state, verify=False
                 )
+                _telemetry.event("run.resumed", step=it, path=latest)
+                _telemetry.counter("resilience.resumes").inc()
                 print(
                     f"[igg.resilience] resumed from checkpoint {latest} "
                     f"(step {it})",
@@ -963,6 +1037,10 @@ class RunGuard:
 
     def _trip(self, state: tuple, it: int, report: FieldReport) -> tuple:
         msg = f"NaN/Inf guard tripped at step {it}: {report.summary()}"
+        _telemetry.event(
+            "guard.trip", step=it, policy=self.policy, report=report.summary()
+        )
+        _telemetry.counter("resilience.guard_trips").inc()
         if self.policy == "raise":
             raise GuardError(msg, step=it, report=report)
         if self.policy == "warn":
@@ -989,4 +1067,8 @@ class RunGuard:
             RuntimeWarning,
             stacklevel=3,
         )
+        _telemetry.event(
+            "guard.rollback", step=it, to_step=self._last_good_step
+        )
+        _telemetry.counter("resilience.rollbacks").inc()
         return snapshot_state(self._last_good), self._last_good_step

@@ -1,0 +1,535 @@
+"""Telemetry: process-local metrics registry + per-process JSONL event log.
+
+The reference's entire instrumentation story is ``tic``/``toc``
+(`/root/reference/src/tools.jl:230-236`), yet its headline claims are
+*measurements* — weak-scaling efficiency and the effective memory throughput
+``T_eff`` the ImplicitGlobalGrid/ParallelStencil papers report solver
+performance in.  This module is the first-class observability layer behind
+those numbers (docs/observability.md):
+
+* **Metrics registry** — process-local counters, gauges and histograms
+  (bounded reservoirs), keyed by dotted names (``halo.exchanges``,
+  ``diffusion3d.t_eff_gbs``).  `snapshot()` returns the whole registry as
+  plain data; `dump_metrics` writes it as JSON *and* Prometheus text
+  exposition so any scrape/collect pipeline can ingest it.
+* **Event log** — append-only JSONL, one file per process under
+  ``IGG_TELEMETRY_DIR`` (``events.jsonl`` for process 0, ``events.pN.jsonl``
+  for the rest).  Every line carries an absolute timestamp, the process
+  rank, pid and (when a grid is up) the block coordinates — so a soak
+  failover drill yields a machine-readable cross-process timeline of
+  crashes, checkpoint fallbacks, elastic reshards and recoveries.  Lines
+  are written with a single ``os.write`` on an ``O_APPEND`` descriptor:
+  crash-safe (a hard ``os._exit`` right after an `event` call loses
+  nothing) and interleaving-safe across processes.
+* **Step-loop instrumentation** — `step_loop` hands the models'
+  `guarded_time_loop` a per-step recorder: wall time, steps/s and the
+  built-in ``T_eff`` (GB/s) from the solver's bytes-moved-per-step model
+  (the reference perf convention: only arrays that *must* stream per step
+  count, so ``T_eff = bytes_model / t_step`` is a lower bound on achieved
+  HBM traffic), plus an optional rank-0 heartbeat line every
+  ``IGG_HEARTBEAT_EVERY`` steps.
+
+Zero overhead when disabled: with ``IGG_TELEMETRY=0`` every accessor
+returns a shared no-op singleton (`counter`/`gauge`/`histogram`) or ``None``
+(`step_loop`), `event` returns before touching the filesystem, and the
+instrumented hot paths guard on `enabled()` — no allocation, no locks, no
+timestamps on the disabled branch (pinned by ``tests/test_telemetry.py``).
+
+The registry is process-lifetime state (NOT reset by `finalize_global_grid`
+— a run's metrics outlive its grid); `reset()` exists for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from . import config as _config
+
+__all__ = [
+    "enabled",
+    "counter",
+    "gauge",
+    "histogram",
+    "event",
+    "snapshot",
+    "telemetry_snapshot",
+    "dump_metrics",
+    "prometheus_text",
+    "step_loop",
+    "teff_bytes",
+    "reset",
+]
+
+
+def enabled() -> bool:
+    """The ``IGG_TELEMETRY`` master switch (read per call, like IGG_DONATE)."""
+    return _config.telemetry_enabled_env()
+
+
+# -- Metric types -------------------------------------------------------------
+
+#: reservoir size of every histogram — enough for stable p50/p90/p99 while
+#: bounding a million-step run's memory to a few KiB per metric
+RESERVOIR_SIZE = 512
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` is the only mutator (never decremented)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int | float = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming distribution: count/sum/min/max + a bounded reservoir.
+
+    The reservoir is classic Vitter-R sampling with a per-histogram seeded
+    PRNG — deterministic for a given record sequence (tests), uniform over
+    the stream, and bounded at `RESERVOIR_SIZE` samples however many values
+    are recorded.  Quantiles in `summary()` come from the reservoir.
+    """
+
+    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng")
+
+    def __init__(self, name: str):
+        import random
+
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._samples: list[float] = []
+        self._rng = random.Random(0x1661)  # seeded: deterministic reservoirs
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if len(self._samples) < RESERVOIR_SIZE:
+            self._samples.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < RESERVOIR_SIZE:
+                self._samples[j] = v
+
+    def quantile(self, q: float) -> float | None:
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
+        return s[idx]
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.total / self.count) if self.count else None,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+class _Noop:
+    """Shared do-nothing metric: the disabled-mode singleton every accessor
+    returns — identity-stable so tests can pin the zero-allocation branch."""
+
+    __slots__ = ()
+    name = "<noop>"
+    value = 0
+
+    def inc(self, n: int | float = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def record(self, v: float) -> None:
+        pass
+
+
+NOOP = _Noop()
+
+
+# -- Registry -----------------------------------------------------------------
+
+_lock = threading.Lock()
+_counters: dict[str, Counter] = {}
+_gauges: dict[str, Gauge] = {}
+_histograms: dict[str, Histogram] = {}
+# (dir, filename) -> fd of the open event log
+_event_fds: dict[tuple[str, str], int] = {}
+
+
+def counter(name: str) -> Counter | _Noop:
+    """The registry counter ``name`` (created on first use); `NOOP` when
+    telemetry is disabled."""
+    if not enabled():
+        return NOOP
+    with _lock:
+        m = _counters.get(name)
+        if m is None:
+            m = _counters[name] = Counter(name)
+        return m
+
+
+def gauge(name: str) -> Gauge | _Noop:
+    if not enabled():
+        return NOOP
+    with _lock:
+        m = _gauges.get(name)
+        if m is None:
+            m = _gauges[name] = Gauge(name)
+        return m
+
+
+def histogram(name: str) -> Histogram | _Noop:
+    if not enabled():
+        return NOOP
+    with _lock:
+        m = _histograms.get(name)
+        if m is None:
+            m = _histograms[name] = Histogram(name)
+        return m
+
+
+def reset() -> None:
+    """Drop every metric and close the event-log descriptors (test hook)."""
+    global _rank_hint
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _histograms.clear()
+        for fd in _event_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        _event_fds.clear()
+    _rank_hint = None
+
+
+# -- Identity tagging ---------------------------------------------------------
+
+# Rank during bring-up, BEFORE the runtime can answer `jax.process_index()`:
+# `parallel.distributed.init_distributed` stages its resolved process_id here
+# so retry/fault events fired mid-bring-up land in the right per-rank file
+# with the right tag (otherwise every process would claim rank 0 and write
+# into rank 0's events.jsonl — exactly the events most worth attributing).
+# Auto-detected pods without an explicit process_id cannot stage a hint; their
+# bring-up events fall back to rank 0 (the pid field still disambiguates).
+_rank_hint: int | None = None
+
+
+def set_rank_hint(rank: int | None) -> None:
+    """Stage the process rank for event tagging during runtime bring-up."""
+    global _rank_hint
+    _rank_hint = None if rank is None else int(rank)
+
+
+def _proc_index() -> int:
+    """Process rank without touching an absent runtime (hint/0 during
+    bring-up — see `_rank_hint`)."""
+    try:
+        import jax
+
+        from ..parallel import distributed as _dist
+
+        if _dist.is_distributed_initialized():
+            return jax.process_index()
+    except Exception:
+        pass
+    return _rank_hint if _rank_hint is not None else 0
+
+
+def _grid_coords() -> list[int] | None:
+    try:
+        from ..parallel import grid as _grid
+
+        if _grid.grid_is_initialized():
+            return list(_grid.global_grid().coords)
+    except Exception:
+        pass
+    return None
+
+
+# -- Event log ----------------------------------------------------------------
+
+
+def _event_filename(rank: int) -> str:
+    return "events.jsonl" if rank == 0 else f"events.p{rank}.jsonl"
+
+
+def event(etype: str, **payload: Any) -> None:
+    """Append one rank/coords-tagged event line to this process's JSONL log.
+
+    No-op unless telemetry is enabled AND ``IGG_TELEMETRY_DIR`` is set.
+    The line is serialized first and written with one ``os.write`` on an
+    ``O_APPEND`` descriptor — crash-safe (complete lines or nothing, even
+    through an ``os._exit`` right after) and safe against cross-process
+    interleaving in a shared directory.  Non-serializable payload values
+    are stringified rather than dropped (an event log must never raise out
+    of a hot path or a crash handler).
+    """
+    if not enabled():
+        return
+    directory = _config.telemetry_dir_env()
+    if not directory:
+        return
+    rank = _proc_index()
+    rec = {
+        "ts": time.time(),
+        "type": etype,
+        "rank": rank,
+        "pid": os.getpid(),
+        "coords": _grid_coords(),
+    }
+    rec.update(payload)
+    try:
+        line = json.dumps(rec, default=str) + "\n"
+    except (TypeError, ValueError):
+        line = json.dumps({k: str(v) for k, v in rec.items()}) + "\n"
+    key = (directory, _event_filename(rank))
+    try:
+        with _lock:
+            fd = _event_fds.get(key)
+            if fd is None:
+                os.makedirs(directory, exist_ok=True)
+                fd = os.open(
+                    os.path.join(*key),
+                    os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                    0o644,
+                )
+                _event_fds[key] = fd
+        os.write(fd, line.encode())
+    except OSError:
+        pass  # a full/unwritable disk must not take the run down
+
+
+def read_events(path: str | os.PathLike) -> list[dict]:
+    """Parse one JSONL event file (helper for tests/tools); skips any
+    torn trailing line."""
+    out = []
+    with open(os.fspath(path)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+# -- Snapshot + exposition ----------------------------------------------------
+
+
+def snapshot() -> dict:
+    """The whole registry as plain data (JSON-serializable)."""
+    with _lock:
+        return {
+            "enabled": enabled(),
+            "rank": _proc_index(),
+            "pid": os.getpid(),
+            "coords": _grid_coords(),
+            "ts": time.time(),
+            "counters": {n: c.value for n, c in _counters.items()},
+            "gauges": {n: g.value for n, g in _gauges.items()},
+            "histograms": {n: h.summary() for n, h in _histograms.items()},
+        }
+
+
+#: public-API alias (exported as ``igg.telemetry_snapshot``)
+telemetry_snapshot = snapshot
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric name: ``igg_`` prefix, dots/dashes to underscores."""
+    safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return f"igg_{safe}"
+
+
+def prometheus_text(snap: dict | None = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of a registry snapshot.
+
+    Counters as ``counter``, gauges as ``gauge``, histograms as ``summary``
+    (reservoir quantiles + ``_sum``/``_count``).  Every line group carries
+    its ``# TYPE`` header, so standard parsers/scrapers accept the output.
+    """
+    if snap is None:
+        snap = snapshot()
+    lines: list[str] = []
+    for name, value in sorted(snap.get("counters", {}).items()):
+        pn = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {value}")
+    for name, value in sorted(snap.get("gauges", {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {value}")
+    for name, s in sorted(snap.get("histograms", {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            v = s.get(key)
+            if v is not None:
+                lines.append(f'{pn}{{quantile="{q}"}} {v}')
+        lines.append(f"{pn}_sum {s.get('sum', 0.0)}")
+        lines.append(f"{pn}_count {s.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def dump_metrics(path: str | os.PathLike) -> tuple[str, str]:
+    """Write the registry snapshot as JSON and Prometheus text.
+
+    ``path`` is the basename: ``<path>.json`` and ``<path>.prom`` are
+    written (a ``path`` already ending in ``.json`` keeps that name and the
+    exposition drops the suffix).  Returns ``(json_path, prom_path)``.
+    Exported as ``igg.dump_metrics``.
+    """
+    path = os.fspath(path)
+    base = path[: -len(".json")] if path.endswith(".json") else path
+    json_path, prom_path = base + ".json", base + ".prom"
+    snap = snapshot()
+    d = os.path.dirname(base)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(json_path, "w") as f:
+        json.dump(snap, f, indent=1, default=str)
+    with open(prom_path, "w") as f:
+        f.write(prometheus_text(snap))
+    return json_path, prom_path
+
+
+# -- Step-loop instrumentation ------------------------------------------------
+
+
+def teff_bytes(fields: Sequence) -> int:
+    """The solver's bytes-moved-per-step model from its must-stream fields.
+
+    Reference perf convention (ParallelStencil/IGG papers; bench.py's
+    ``A_eff``): only arrays that MUST stream once in and once out per step
+    count, i.e. ``2 * sum(nbytes)`` of the evolving state — reads of
+    read-only parameter fields and the halo traffic are free on top, so
+    ``T_eff = teff_bytes / t_step`` is a lower bound on achieved traffic.
+    Per solver (docs/observability.md): diffusion counts T; acoustic counts
+    P, Vx, Vy, Vz; porous convection counts T, Pf, qDx, qDy, qDz.  Sizes
+    are the GLOBAL arrays' (aggregate throughput; divide by block count for
+    a per-device figure).
+    """
+    total = 0
+    for A in fields:
+        nbytes = getattr(A, "nbytes", None)
+        if nbytes is None:
+            import numpy as np
+
+            nbytes = int(np.prod(A.shape)) * np.dtype(A.dtype).itemsize
+        total += int(nbytes)
+    return 2 * total
+
+
+class _StepLoop:
+    """Per-step recorder handed to the models' time loops (see `step_loop`)."""
+
+    def __init__(self, model: str, bytes_per_step: int | None,
+                 start_step: int, total_steps: int, heartbeat_every: int):
+        self.model = model
+        self.bytes_per_step = bytes_per_step
+        self.total_steps = total_steps
+        self.heartbeat_every = heartbeat_every
+        self._is_rank0 = _proc_index() == 0
+        self._steps = counter(f"{model}.steps")
+        self._step_s = histogram(f"{model}.step_seconds")
+        self._sps = gauge(f"{model}.steps_per_s")
+        self._teff = histogram(f"{model}.t_eff_gbs") if bytes_per_step else None
+        self._teff_g = gauge(f"{model}.t_eff_gbs_last") if bytes_per_step else None
+        self._t_last = time.perf_counter()
+        event("run.start", model=model, start_step=start_step,
+              total_steps=total_steps, bytes_per_step=bytes_per_step)
+
+    def on_step(self, it: int) -> None:
+        """Record one completed step (wall time since the previous call)."""
+        now = time.perf_counter()
+        dt = now - self._t_last
+        self._t_last = now
+        self._steps.inc()
+        self._step_s.record(dt)
+        if dt > 0:
+            self._sps.set(1.0 / dt)
+        gbs = None
+        if self._teff is not None and dt > 0:
+            gbs = self.bytes_per_step / dt / 1e9
+            self._teff.record(gbs)
+            self._teff_g.set(gbs)
+        if (
+            self.heartbeat_every
+            and self._is_rank0
+            and it % self.heartbeat_every == 0
+        ):
+            import sys
+
+            teff_s = f" T_eff {gbs:.2f} GB/s" if gbs is not None else ""
+            print(
+                f"[igg.telemetry] {self.model} step {it}/{self.total_steps} "
+                f"{dt * 1e3:.2f} ms/step {1.0 / dt if dt > 0 else 0.0:.1f} "
+                f"steps/s{teff_s}",
+                file=sys.stderr,
+                flush=True,
+            )
+            event("heartbeat", model=self.model, step=it,
+                  step_seconds=dt, t_eff_gbs=gbs)
+
+    def finish(self, it: int) -> None:
+        event("run.complete", model=self.model, step=it)
+
+
+def step_loop(
+    model: str,
+    *,
+    bytes_per_step: int | None = None,
+    start_step: int = 0,
+    total_steps: int = 0,
+) -> _StepLoop | None:
+    """A per-step recorder for a host-side time loop, or ``None`` disabled.
+
+    The ``None`` return IS the zero-overhead contract: the caller's loop
+    guards every telemetry touch behind ``if tele is not None`` and the
+    disabled path allocates nothing per step (``tests/test_telemetry.py``
+    pins this).  ``bytes_per_step`` (see `teff_bytes`) switches on the
+    built-in ``T_eff``; heartbeat cadence comes from ``IGG_HEARTBEAT_EVERY``.
+    """
+    if not enabled():
+        return None
+    hb = _config.heartbeat_every_env() or 0
+    return _StepLoop(model, bytes_per_step, start_step, total_steps, hb)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Static lint: every ``IGG_*`` knob must be declared and documented.
+
+The configuration tier's whole value is discoverability — an env var read
+deep inside a hot path that appears in neither `utils/config.py` nor
+`docs/usage.md` is a knob nobody can find (exactly how ``IGG_GATHER_BATCH``
+went undocumented for two rounds).  This lint closes the loop:
+
+* scan every ``.py`` under ``implicitglobalgrid_tpu/`` (excluding
+  ``utils/config.py`` itself — the declaration site) for ``IGG_[A-Z0-9_]+``
+  tokens;
+* each referenced knob must appear in ``utils/config.py`` (docstring table
+  or accessor) AND in ``docs/usage.md``.
+
+Run standalone (exits nonzero listing violations) or via the tier-1 test
+``tests/test_knob_lint.py`` — an undocumented knob fails the suite.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PACKAGE = os.path.join(REPO, "implicitglobalgrid_tpu")
+CONFIG = os.path.join(PACKAGE, "utils", "config.py")
+USAGE = os.path.join(REPO, "docs", "usage.md")
+
+_KNOB = re.compile(r"IGG_[A-Z0-9_]+")
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def referenced_knobs() -> dict[str, list[str]]:
+    """``knob -> [repo-relative files referencing it]`` over the package,
+    excluding the declaration site (utils/config.py)."""
+    refs: dict[str, list[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(PACKAGE):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            if os.path.samefile(path, CONFIG):
+                continue
+            rel = os.path.relpath(path, REPO)
+            for knob in set(_KNOB.findall(_read(path))):
+                refs.setdefault(knob, []).append(rel)
+    return {k: sorted(v) for k, v in sorted(refs.items())}
+
+
+def violations() -> list[str]:
+    """Human-readable lint failures (empty = clean)."""
+    declared = set(_KNOB.findall(_read(CONFIG)))
+    documented = set(_KNOB.findall(_read(USAGE)))
+    out = []
+    for knob, files in referenced_knobs().items():
+        where = ", ".join(files)
+        if knob not in declared:
+            out.append(
+                f"{knob} (referenced in {where}) is not declared in "
+                f"implicitglobalgrid_tpu/utils/config.py — add it to the "
+                f"knob table (and an accessor if it is read per call)"
+            )
+        if knob not in documented:
+            out.append(
+                f"{knob} (referenced in {where}) is not documented in "
+                f"docs/usage.md — add a row to the env-var table"
+            )
+    return out
+
+
+def main() -> int:
+    probs = violations()
+    if probs:
+        print("check_knobs: FAIL")
+        for p in probs:
+            print(f"  - {p}")
+        return 1
+    nrefs = len(referenced_knobs())
+    print(f"check_knobs: OK ({nrefs} IGG_* knob(s) declared + documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

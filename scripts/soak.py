@@ -35,6 +35,14 @@ Scenarios:
 Each scenario runs in a fresh child process (a crash must not take the
 orchestrator down, and init faults need a pristine runtime).
 
+The elastic drill runs with telemetry armed (``IGG_TELEMETRY_DIR``,
+docs/observability.md): the supervisor verifies the per-rank
+``events.jsonl`` timeline contains the crash, the checkpoint fallback past
+the damaged generation, the elastic reshard and the recovery IN ORDER, and
+that the restarted child's `igg.dump_metrics` output is valid JSON +
+Prometheus text with per-step ``T_eff`` recorded — the soak consumes the
+telemetry snapshot instead of private tallies.
+
 ``--quick`` runs only the ``elastic_failover`` drill at small size — the
 fast crash→shrunk-topology-restart smoke path (registered next to the
 tier-1 command in docs/testing.md).
@@ -172,14 +180,20 @@ def child_elastic_main(args) -> int:
         checkpoint_dir=args.ckpt_dir,
         names=("T", "Cp"),
     )
+    from implicitglobalgrid_tpu.utils.telemetry import teff_bytes
+
     state = resilience.guarded_time_loop(
-        step, state, args.steps, guard=guard, sync_every_step=True
+        step, state, args.steps, guard=guard, sync_every_step=True,
+        model="diffusion3d", bytes_per_step=teff_bytes(state[:1]),
     )
     T = diffusion3d.temperature(state)
     dd = igg.gather(T, dedup=True, root=0)
     if jax.process_index() == 0:
         assert dd is not None and np.isfinite(dd).all()
         np.save(args.out, dd)
+        # The machine-readable run record (docs/observability.md): registry
+        # snapshot as JSON + Prometheus text next to the field.
+        igg.dump_metrics(args.out + ".metrics")
     igg.finalize_global_grid()
     print("SOAK CHILD OK", flush=True)
     return 0
@@ -267,6 +281,88 @@ def _elastic_env(env_extra: dict) -> dict:
     return env
 
 
+def _verify_elastic_telemetry(tele_dir: str, got_out: str) -> tuple[bool, str]:
+    """The drill's machine-readable acceptance (docs/observability.md).
+
+    The per-rank ``events.jsonl`` files must contain the crash, the
+    checkpoint fallback past the damaged generation, the elastic reshard
+    and the recovery IN ORDER (absolute timestamps make the cross-process
+    timeline sortable), and the restart's `igg.dump_metrics` output must be
+    valid JSON + Prometheus text with per-step ``T_eff`` recorded.
+    """
+    import glob
+    import json
+
+    if REPO not in sys.path:  # the orchestrator runs from anywhere
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu.utils.telemetry import read_events
+
+    files = sorted(glob.glob(os.path.join(tele_dir, "events*.jsonl")))
+    if not files:
+        return False, f"no events*.jsonl under {tele_dir}"
+    events = [e for f in files for e in read_events(f)]
+    # Tag check BEFORE the ts sort: a malformed line must yield this report,
+    # not a KeyError/TypeError out of sorted().
+    if any(
+        "rank" not in e or not isinstance(e.get("ts"), (int, float))
+        for e in events
+    ):
+        return False, "event lines missing rank/ts tags"
+    events.sort(key=lambda e: e["ts"])
+    milestones = (
+        ("crash", lambda e: e["type"] == "fault.worker_crash"),
+        ("fallback", lambda e: e["type"] == "checkpoint.fallback"),
+        ("reshard", lambda e: e["type"] == "checkpoint.restore"
+         and e.get("mode") == "elastic"),
+        ("recovery", lambda e: e["type"] == "run.complete"),
+    )
+    i = 0
+    for name, pred in milestones:
+        while i < len(events) and not pred(events[i]):
+            i += 1
+        if i >= len(events):
+            seen = sorted({e["type"] for e in events})
+            return False, (
+                f"event timeline missing '{name}' (in order); saw {seen}"
+            )
+        i += 1
+    ranks = {e["rank"] for e in events}
+    if not {0, 1} <= ranks:
+        return False, f"expected rank-tagged events from both ranks, got {ranks}"
+
+    json_path, prom_path = got_out + ".metrics.json", got_out + ".metrics.prom"
+    try:
+        with open(json_path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"metrics JSON unreadable ({e!r})"
+    teff = snap.get("histograms", {}).get("diffusion3d.t_eff_gbs", {})
+    if not teff.get("count"):
+        return False, f"no per-step T_eff recorded in {json_path}"
+    try:
+        with open(prom_path) as f:
+            prom = f.read()
+    except OSError as e:
+        return False, f"Prometheus dump unreadable ({e!r})"
+    for line in prom.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            return False, f"malformed Prometheus line {line!r}"
+        try:
+            float(parts[1])
+        except ValueError:
+            return False, f"non-numeric Prometheus sample {line!r}"
+    if "igg_diffusion3d_t_eff_gbs" not in prom:
+        return False, "T_eff summary missing from the Prometheus exposition"
+    return True, (
+        f"{len(events)} events across {len(files)} rank file(s): "
+        f"crash -> fallback -> elastic reshard -> recovery in order; "
+        f"T_eff over {teff['count']} step(s)"
+    )
+
+
 def supervise_elastic_failover(args) -> bool:
     """The supervisor: run the 2-process job, detect the injected crash,
     relaunch on a shrunk 1-process topology from the latest VALID
@@ -278,6 +374,12 @@ def supervise_elastic_failover(args) -> bool:
     workdir = args.workdir
     ckpt = os.path.join(workdir, "ckpt_elastic")
     shutil.rmtree(ckpt, ignore_errors=True)
+    # Telemetry armed for the pair AND the restart (same directory): the
+    # drill must yield one machine-readable cross-process timeline.  The
+    # oracle leg stays un-armed — its events would pollute the timeline.
+    tele_dir = os.path.join(workdir, "telemetry_elastic")
+    shutil.rmtree(tele_dir, ignore_errors=True)
+    tele_env = {"IGG_TELEMETRY": "1", "IGG_TELEMETRY_DIR": tele_dir}
     if args.steps < 6:
         return _report(
             "elastic", False,
@@ -301,7 +403,10 @@ def supervise_elastic_failover(args) -> bool:
     # (2) the 2-process job with crash + newest-generation corruption armed
     port = _free_port()
     env = _elastic_env(
-        {"IGG_FAULT_INJECT": f"worker_crash:step{mid}:proc1,ckpt_corrupt:step{mid}"}
+        {
+            "IGG_FAULT_INJECT": f"worker_crash:step{mid}:proc1,ckpt_corrupt:step{mid}",
+            **tele_env,
+        }
     )
     logs = [
         open(os.path.join(workdir, f"elastic_pair{pid}.log"), "w+")
@@ -346,7 +451,7 @@ def supervise_elastic_failover(args) -> bool:
     proc = _run_child(
         _elastic_cmd(args, nproc=1, pair_id=0, port=0, ckpt=ckpt, out=got_out,
                      expect_resume=mid - 2),
-        _elastic_env({}), args.timeout,
+        _elastic_env(dict(tele_env)), args.timeout,
     )
     if proc.returncode != 0:
         print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
@@ -356,10 +461,16 @@ def supervise_elastic_failover(args) -> bool:
     ok = got.shape == oracle.shape and np.allclose(
         got, oracle, rtol=1e-13, atol=1e-13
     )
+    # (4) the observability acceptance: rank-tagged event timeline in order
+    # + a valid metrics dump with per-step T_eff (docs/observability.md).
+    tele_ok, tele_detail = _verify_elastic_telemetry(tele_dir, got_out)
+    if not tele_ok:
+        return _report("elastic", False, f"telemetry: {tele_detail}")
     return _report(
         "elastic", ok,
         f"crash rc=17 -> fallback to step {mid - 2} -> 1-proc restart "
-        f"(max |err| {np.max(np.abs(got - oracle)) if got.shape == oracle.shape else 'shape mismatch'})",
+        f"(max |err| {np.max(np.abs(got - oracle)) if got.shape == oracle.shape else 'shape mismatch'}); "
+        f"telemetry: {tele_detail}",
     )
 
 

@@ -35,6 +35,12 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
 ).strip()
 
+# Telemetry armed for the whole worker run (docs/observability.md): both
+# ranks log into one shared directory; the parent test asserts per-rank
+# event files with consistent rank/coords tags.
+os.environ["IGG_TELEMETRY"] = "1"
+os.environ["IGG_TELEMETRY_DIR"] = out_path + ".telemetry"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -226,6 +232,26 @@ for _ in range(NSTEPS):
 Th = igg.gather(diffusion3d.temperature(state4), root=ROOT)
 if jax.process_index() == ROOT:
     np.save(out_path + ".hc.npy", Th)
+
+# --- Telemetry across the real process boundary (docs/observability.md):
+# every rank writes its OWN event file, tagged with its runtime rank and
+# grid coords; the registry folded the gathers/exchanges above.
+from implicitglobalgrid_tpu.utils import telemetry as tele
+
+assert jax.process_index() == pid  # rank tag source below
+tele.event("worker.check", nsteps=NSTEPS)
+snap = tele.snapshot()
+assert snap["rank"] == pid, snap
+assert snap["counters"].get("gather.calls", 0) >= 5, snap["counters"]
+assert snap["counters"].get("gather.calls.chunked", 0) >= 5, snap["counters"]
+assert snap["counters"].get("halo.exchanges", 0) >= 1, snap["counters"]
+_ev_file = os.path.join(
+    os.environ["IGG_TELEMETRY_DIR"],
+    "events.jsonl" if pid == 0 else f"events.p{pid}.jsonl",
+)
+_mine = [e for e in tele.read_events(_ev_file) if e["type"] == "worker.check"]
+assert len(_mine) == 1 and _mine[0]["rank"] == pid, _mine
+assert _mine[0]["coords"] == list(igg.get_global_grid().coords), _mine
 
 igg.finalize_global_grid()
 assert not igg.grid_is_initialized()

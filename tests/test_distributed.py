@@ -374,3 +374,31 @@ def test_gather_invalid_root_raises():
     with pytest.raises(ValueError, match="root"):
         igg.gather(T, root=-1)
     igg.finalize_global_grid()
+
+
+def test_two_process_rank_tagged_telemetry_events(dist_out_path):
+    """The 2-process gloo leg of the observability acceptance
+    (docs/observability.md): both ranks of the worker pair must have
+    written their OWN JSONL event file into the shared telemetry
+    directory, every line rank/pid/coords-tagged and schema-complete, with
+    the two ranks disagreeing exactly where they must (rank, pid, coords)."""
+    from implicitglobalgrid_tpu.utils.telemetry import read_events
+
+    tdir = dist_out_path + ".telemetry"
+    f0 = os.path.join(tdir, "events.jsonl")
+    f1 = os.path.join(tdir, "events.p1.jsonl")
+    assert os.path.isfile(f0), f"rank 0 wrote no event log under {tdir}"
+    assert os.path.isfile(f1), f"rank 1 wrote no event log under {tdir}"
+    e0, e1 = read_events(f0), read_events(f1)
+    checks = []
+    for rank, events in ((0, e0), (1, e1)):
+        for e in events:
+            assert {"ts", "type", "rank", "pid", "coords"} <= set(e), e
+        mine = [e for e in events if e["type"] == "worker.check"]
+        assert len(mine) == 1, (rank, [e["type"] for e in events])
+        assert mine[0]["rank"] == rank
+        checks.append(mine[0])
+    # Distinct processes, distinct blocks: pid and grid coords must differ.
+    assert checks[0]["pid"] != checks[1]["pid"]
+    assert checks[0]["coords"] != checks[1]["coords"]
+    assert checks[0]["coords"] is not None and checks[1]["coords"] is not None

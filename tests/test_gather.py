@@ -230,3 +230,20 @@ def test_gather_after_block_slice():
     for cx in range(dims[0]):
         blk = g[cx * 2:(cx + 1) * 2, 0:2, 0:2]
         np.testing.assert_array_equal(blk, expect)
+
+
+def test_gather_stats_reset_at_call_start():
+    """PR-4 satellite: `last_gather_stats` is reset at the START of every
+    gather, so a call that fails (here: before any collective) cannot leave
+    the previous call's stats lying around as its own."""
+    from implicitglobalgrid_tpu.ops import gather as gather_mod
+
+    igg.init_global_grid(4, 4, 4, quiet=True)
+    A = igg.from_block_fn(
+        lambda c: jnp.ones((4, 4, 4), jnp.float32), (4, 4, 4), jnp.float32
+    )
+    assert igg.gather(A) is not None
+    assert gather_mod.last_gather_stats is not None
+    with pytest.raises(ValueError, match="root must be a valid process index"):
+        igg.gather(A, root=99)
+    assert gather_mod.last_gather_stats is None

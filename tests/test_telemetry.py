@@ -1,0 +1,423 @@
+"""Telemetry subsystem tests (docs/observability.md).
+
+Covers the registry semantics (counter/gauge/histogram, disabled-mode
+no-op), the JSONL event-log schema round-trip, the per-step metrics every
+model's ``run()`` emits (wall time, steps/s, T_eff), the named profiler
+annotations landing in compiled-HLO op metadata (the toolchain-independent
+stand-in for a live `jax.profiler` capture on this CPU-only environment),
+and the Prometheus/JSON exposition of `igg.dump_metrics`.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils import telemetry as tele
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    tele.reset()
+    yield
+    tele.reset()
+
+
+# -- Registry semantics -------------------------------------------------------
+
+
+def test_counter_gauge_histogram_semantics():
+    c = tele.counter("t.count")
+    c.inc()
+    c.inc(4)
+    assert tele.counter("t.count") is c  # one instance per name
+    assert c.value == 5
+
+    g = tele.gauge("t.gauge")
+    g.set(2.5)
+    g.set(7)
+    assert tele.gauge("t.gauge").value == 7.0
+
+    h = tele.histogram("t.hist")
+    for v in range(1, 101):
+        h.record(float(v))
+    s = h.summary()
+    assert s["count"] == 100 and s["min"] == 1.0 and s["max"] == 100.0
+    assert s["sum"] == pytest.approx(5050.0)
+    assert s["mean"] == pytest.approx(50.5)
+    assert 40 <= s["p50"] <= 61 and s["p90"] >= s["p50"] and s["p99"] >= s["p90"]
+
+    snap = tele.snapshot()
+    assert snap["counters"]["t.count"] == 5
+    assert snap["gauges"]["t.gauge"] == 7.0
+    assert snap["histograms"]["t.hist"]["count"] == 100
+
+
+def test_histogram_reservoir_bounded_and_deterministic():
+    h = tele.histogram("t.res")
+    for v in range(10_000):
+        h.record(float(v))
+    assert h.count == 10_000
+    assert len(h._samples) == tele.RESERVOIR_SIZE
+    # Seeded PRNG: the same record sequence yields the same reservoir.
+    h2 = tele.Histogram("t.res2")
+    for v in range(10_000):
+        h2.record(float(v))
+    assert h._samples == h2._samples
+
+
+def test_disabled_mode_takes_zero_allocation_branch(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    assert not tele.enabled()
+    # The acceptance contract: disabled accessors return the SHARED no-op
+    # singleton (no per-call allocation) and the step loop is None, so the
+    # models' loops reduce to one `is not None` check per step.
+    assert tele.counter("t.x") is tele.NOOP
+    assert tele.gauge("t.y") is tele.NOOP
+    assert tele.histogram("t.z") is tele.NOOP
+    tele.NOOP.inc()
+    tele.NOOP.set(1.0)
+    tele.NOOP.record(1.0)
+    assert tele.step_loop("m", bytes_per_step=8) is None
+    tele.event("t.never", foo=1)
+    assert list(tmp_path.iterdir()) == []  # no event file, no registry entry
+    snap = tele.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+
+
+def test_disabled_model_run_records_nothing(monkeypatch):
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    diffusion3d.run(1, 8, 8, 8, quiet=True)
+    assert tele.snapshot()["counters"] == {}
+
+
+# -- Event log ----------------------------------------------------------------
+
+
+def test_event_jsonl_schema_roundtrip(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    import time
+
+    t0 = time.time()
+    tele.event("unit.test", step=3, detail="abc")
+    tele.event("unit.test2", nested={"a": 1})
+    path = tmp_path / "events.jsonl"  # single process = rank 0
+    assert path.is_file()
+    events = tele.read_events(path)
+    assert [e["type"] for e in events] == ["unit.test", "unit.test2"]
+    e = events[0]
+    # Schema: absolute timestamp, rank/pid/coords tags, payload verbatim.
+    assert {"ts", "type", "rank", "pid", "coords"} <= set(e)
+    assert t0 <= e["ts"] <= time.time()
+    assert e["rank"] == 0 and e["pid"] == os.getpid()
+    assert e["step"] == 3 and e["detail"] == "abc"
+    assert events[1]["nested"] == {"a": 1}
+    # Append-only: a second emitter call extends, never truncates.
+    tele.event("unit.test3")
+    assert len(tele.read_events(path)) == 3
+
+
+def test_event_coords_tagged_when_grid_up(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tele.event("before.grid")
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    tele.event("with.grid")
+    events = tele.read_events(tmp_path / "events.jsonl")
+    assert events[0]["coords"] is None
+    assert events[1]["coords"] == list(igg.get_global_grid().coords)
+
+
+def test_event_rank_hint_during_bringup(monkeypatch, tmp_path):
+    """Bring-up events (before the runtime can answer process_index) must be
+    tagged and FILED under the rank `init_distributed` staged via
+    `set_rank_hint` — not misattributed to rank 0 (code-review finding)."""
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tele.set_rank_hint(3)
+    tele.event("bringup.retry")
+    path = tmp_path / "events.p3.jsonl"
+    assert path.is_file()
+    (e,) = tele.read_events(path)
+    assert e["rank"] == 3
+    tele.reset()  # reset drops the hint with the registry
+    tele.event("after.reset")
+    (e2,) = tele.read_events(tmp_path / "events.jsonl")
+    assert e2["rank"] == 0
+
+
+def test_watchdog_deadline_exceeded_event(monkeypatch, tmp_path):
+    """A watchdog scope outliving its deadline leaves the timeline marker
+    (the observable proxy for the faulthandler dump)."""
+    import time as _time
+
+    from implicitglobalgrid_tpu.utils.resilience import watchdog
+
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    with watchdog(0.05):
+        _time.sleep(0.12)
+    events = tele.read_events(tmp_path / "events.jsonl")
+    (e,) = [x for x in events if x["type"] == "watchdog.deadline_exceeded"]
+    assert e["elapsed_s"] > e["timeout_s"] == 0.05
+    snap = tele.snapshot()
+    assert snap["counters"]["resilience.watchdog_deadline_exceeded"] == 1
+
+
+def test_event_non_serializable_payload_stringified(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tele.event("odd.payload", obj=object())
+    (e,) = tele.read_events(tmp_path / "events.jsonl")
+    assert "object object" in e["obj"]
+
+
+# -- Per-step metrics from the models' run loops ------------------------------
+
+
+@pytest.mark.parametrize(
+    "model_name,run_kwargs,nt",
+    [
+        ("diffusion3d", {}, 3),
+        ("acoustic3d", {}, 2),
+        ("porous_convection3d", {"npt": 2}, 1),
+    ],
+)
+def test_model_run_emits_per_step_metrics(model_name, run_kwargs, nt):
+    import importlib
+
+    mod = importlib.import_module(
+        f"implicitglobalgrid_tpu.models.{model_name}"
+    )
+    mod.run(nt, 8, 8, 8, quiet=True, **run_kwargs)
+    snap = tele.snapshot()
+    assert snap["counters"][f"{model_name}.steps"] == nt
+    step_s = snap["histograms"][f"{model_name}.step_seconds"]
+    assert step_s["count"] == nt and step_s["min"] > 0
+    teff = snap["histograms"][f"{model_name}.t_eff_gbs"]
+    assert teff["count"] == nt and teff["min"] > 0
+    assert snap["gauges"][f"{model_name}.steps_per_s"] > 0
+
+
+def test_heartbeat_line_and_event(monkeypatch, tmp_path, capfd):
+    monkeypatch.setenv("IGG_HEARTBEAT_EVERY", "1")
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    from implicitglobalgrid_tpu.models import diffusion3d
+
+    diffusion3d.run(2, 8, 8, 8, quiet=True)
+    err = capfd.readouterr().err
+    assert "[igg.telemetry] diffusion3d step" in err
+    assert "T_eff" in err
+    events = tele.read_events(tmp_path / "events.jsonl")
+    types = [e["type"] for e in events]
+    assert types.count("heartbeat") == 2
+    assert types[0] == "run.start" and types[-1] == "run.complete"
+    hb = next(e for e in events if e["type"] == "heartbeat")
+    assert hb["model"] == "diffusion3d" and hb["t_eff_gbs"] > 0
+
+
+def test_teff_bytes_model():
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    T = igg.zeros((8, 8, 8), "float32")
+    V = igg.zeros((9, 8, 8), "float32")
+    # 2 * sum(global nbytes): each must-stream field once in + once out.
+    assert tele.teff_bytes([T]) == 2 * T.nbytes
+    assert tele.teff_bytes([T, V]) == 2 * (T.nbytes + V.nbytes)
+
+
+# -- Instrumented hot paths ---------------------------------------------------
+
+
+def test_update_halo_counters():
+    igg.init_global_grid(
+        8, 8, 8, periodx=1, overlapx=4, overlapy=4, overlapz=4, quiet=True
+    )
+    T = igg.zeros((8, 8, 8), "float64")
+    T = igg.update_halo(T)
+    T = igg.update_halo(T, width=2)
+    snap = tele.snapshot()
+    assert snap["counters"]["halo.exchanges"] == 2
+    assert snap["counters"]["halo.fields"] == 2
+    # Slab payload model: all three dims are active on the default 2x2x2
+    # mesh (periodic x + interior neighbors), 2 slabs/dim of 8*8 f64 planes;
+    # the width-2 call moves twice the width-1 call's bytes.
+    per_plane = 8 * 8 * 8  # elements * itemsize
+    w1 = 3 * 2 * per_plane
+    assert snap["counters"]["halo.bytes"] == w1 + 2 * w1
+    assert snap["histograms"]["halo.slab_bytes"]["count"] == 2
+
+
+def test_gather_registry_fold():
+    from implicitglobalgrid_tpu.ops import gather as gather_mod
+
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    A = igg.zeros((8, 8, 8), "float32")
+    got = igg.gather(A)
+    assert got is not None
+    snap = tele.snapshot()
+    assert snap["counters"]["gather.calls"] == 1
+    assert snap["counters"]["gather.calls.local"] == 1
+    assert snap["counters"]["gather.host_bytes"] == got.nbytes
+    # The compat alias mirrors the registry's last-call view.
+    assert gather_mod.last_gather_stats["path"] == "local"
+
+
+def test_checkpoint_events_and_counters(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path / "tele"))
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    T = igg.zeros((8, 8, 8), "float32")
+    ckdir = tmp_path / "ck"
+    path = igg.save_checkpoint(ckdir, (T,), 2)
+    igg.restore_checkpoint(path, like=(T,))
+    igg.save_checkpoint(ckdir, (T,), 4)
+    igg.prune_checkpoints(ckdir, keep=1)
+    snap = tele.snapshot()
+    assert snap["counters"]["checkpoint.saves"] == 2
+    assert snap["counters"]["checkpoint.restores"] == 1
+    assert snap["counters"]["checkpoint.prunes"] == 1
+    events = tele.read_events(tmp_path / "tele" / "events.jsonl")
+    types = [e["type"] for e in events]
+    assert types == [
+        "checkpoint.saved",
+        "checkpoint.restore",
+        "checkpoint.saved",
+        "checkpoint.prune",
+    ]
+    restore = events[1]
+    assert restore["mode"] == "same_topology" and restore["step"] == 2
+
+
+def test_corrupt_checkpoint_fallback_event(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path / "tele"))
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    T = igg.zeros((8, 8, 8), "float32")
+    ckdir = tmp_path / "ck"
+    igg.save_checkpoint(ckdir, (T,), 2)
+    newest = igg.save_checkpoint(ckdir, (T,), 4)
+    shard = os.path.join(newest, "shards_p0.npz")
+    with open(shard, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff")
+    latest = igg.latest_checkpoint(ckdir)
+    assert latest.endswith("step_00000002")
+    events = tele.read_events(tmp_path / "tele" / "events.jsonl")
+    fb = [e for e in events if e["type"] == "checkpoint.fallback"]
+    assert fb and "corrupt" in fb[0]["problem"]
+    assert tele.snapshot()["counters"]["checkpoint.fallbacks"] >= 1
+
+
+# -- Named profiler annotations ----------------------------------------------
+#
+# This toolchain cannot run a TPU profiler capture; the toolchain-
+# independent check (the ISSUE's jaxpr-level fallback) is that the
+# `named_scope` names land in the compiled executable's op metadata — the
+# exact strings a Perfetto trace groups ops under.
+
+
+def test_pipelined_schedule_scopes_in_compiled_hlo():
+    from implicitglobalgrid_tpu.models._fused import (
+        run_pipelined_group_schedule,
+    )
+
+    def boundary(ki, c):
+        return (c * 2.0,), ["pend"]
+
+    def interior(ki, c, b_out, pend):
+        return jnp.sin(b_out[0]) + c
+
+    def f(x):
+        return run_pipelined_group_schedule([1, 1], boundary, interior, x)
+
+    txt = jax.jit(f).lower(jnp.ones((8,))).compile().as_text()
+    assert "igg_ring_pass" in txt
+    assert "igg_interior_pass" in txt
+
+
+def test_slab_exchange_scopes_in_compiled_hlo():
+    from jax.sharding import PartitionSpec as P
+
+    from implicitglobalgrid_tpu.ops.halo import (
+        begin_slab_exchange,
+        finish_slab_exchange,
+    )
+    from implicitglobalgrid_tpu.utils.compat import shard_map
+
+    igg.init_global_grid(8, 8, 8, periodx=1, quiet=True)
+    gg = igg.get_global_grid()
+
+    def local(T):
+        pends = begin_slab_exchange((T,), (0, 1, 2), width=1)
+        (T,) = finish_slab_exchange((T,), pends)
+        return T
+
+    mapped = shard_map(
+        local,
+        mesh=gg.mesh,
+        in_specs=(P("x", "y", "z"),),
+        out_specs=P("x", "y", "z"),
+        check_vma=False,
+    )
+    T = igg.zeros((8, 8, 8), "float32")
+    txt = jax.jit(mapped).lower(T).compile().as_text()
+    assert "igg_slab_exchange_begin" in txt
+    assert "igg_slab_exchange_finish" in txt
+    # Trace-time counters: one begin/finish schedule was traced.
+    snap = tele.snapshot()
+    assert snap["counters"]["halo.begin_slab_traces"] == 1
+    assert snap["counters"]["halo.finish_slab_traces"] == 1
+
+
+def test_compat_shims_are_context_managers():
+    from implicitglobalgrid_tpu.utils.compat import (
+        named_scope,
+        trace_annotation,
+    )
+
+    with named_scope("igg_test_scope"):
+        pass
+    with trace_annotation("igg_test_annotation"):
+        pass
+
+
+# -- Public surface: snapshot + dumps -----------------------------------------
+
+
+def test_dump_metrics_json_and_prometheus(tmp_path):
+    tele.counter("d.count").inc(3)
+    tele.gauge("d.gauge").set(1.5)
+    h = tele.histogram("d.hist")
+    for v in (1.0, 2.0, 3.0):
+        h.record(v)
+    json_path, prom_path = igg.dump_metrics(tmp_path / "metrics")
+    with open(json_path) as f:
+        snap = json.load(f)
+    assert snap["counters"]["d.count"] == 3
+    assert snap["histograms"]["d.hist"]["count"] == 3
+    prom = open(prom_path).read()
+    assert "# TYPE igg_d_count_total counter" in prom
+    assert "igg_d_count_total 3" in prom
+    assert "# TYPE igg_d_gauge gauge" in prom
+    assert "# TYPE igg_d_hist summary" in prom
+    assert 'igg_d_hist{quantile="0.5"} 2.0' in prom
+    assert "igg_d_hist_sum 6.0" in prom and "igg_d_hist_count 3" in prom
+    # Every sample line is `name[{labels}] value` with a numeric value.
+    for line in prom.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(None, 1)
+        assert name.startswith("igg_")
+        float(value)
+
+
+def test_snapshot_is_json_serializable():
+    tele.counter("s.c").inc()
+    tele.histogram("s.h").record(0.25)
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    snap = igg.telemetry_snapshot()
+    rt = json.loads(json.dumps(snap))
+    assert rt["counters"]["s.c"] == 1
+    assert rt["coords"] == list(igg.get_global_grid().coords)

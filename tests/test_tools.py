@@ -141,3 +141,15 @@ def test_nxg_staggered_multidevice():
     Vx = igg.zeros((5, 4, 4))
     assert igg.nx_g(Vx) == igg.nx_g() + 1
     assert igg.ny_g(Vx) == igg.ny_g()
+
+
+def test_toc_before_tic_raises():
+    # PR-4 satellite: toc() with no chronometer started must raise instead
+    # of returning nonsense measured from an arbitrary epoch (the old
+    # module-load-epoch behavior).  init_global_grid's internal timing
+    # priming must NOT count as a user tic().
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    with pytest.raises(RuntimeError, match=r"toc\(\) called before tic\(\)"):
+        igg.toc()
+    igg.tic()
+    assert igg.toc() >= 0.0
