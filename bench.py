@@ -282,12 +282,11 @@ def main():
     _extra("acoustic_periodxz_pipelined_ab", lambda: _acoustic_ab("xz"))
     _extra("porous_periodxz_pipelined_ab", lambda: _porous_ab("xz"))
 
-    def _weak_codepath():
-        # VERDICT r4 missing #2(a): the virtual-mesh weak-scaling CODE-PATH
-        # record, in the driver artifact itself.  Subprocess: the TPU
-        # backend is already initialized in this process, and the weak mode
-        # is defined on a virtual CPU mesh here (one core timeshares all 8
-        # "devices" — the ratio is NOT a performance number).
+    def _cpu_mesh_json(args, timeout=1800):
+        # Shared subprocess driver for records defined on the virtual
+        # 8-device CPU mesh (the TPU backend is already initialized in this
+        # process; one core timeshares all 8 "devices" there, so wall times
+        # from these runs are code-path records, not performance numbers).
         import subprocess
         import sys
 
@@ -300,8 +299,8 @@ def main():
         )
         out = subprocess.run(
             [sys.executable, os.path.join(_here, "benchmarks", "run.py"),
-             "weak", "--n", "16", "--chunk", "4", "--reps", "2"],
-            capture_output=True, text=True, env=env, timeout=1800,
+             *args],
+            capture_output=True, text=True, env=env, timeout=timeout,
         )
         rec = None
         for line in out.stdout.splitlines():
@@ -313,9 +312,47 @@ def main():
                     continue  # brace-prefixed non-JSON noise
         if rec is None:
             raise RuntimeError(
-                f"weak run produced no JSON (rc={out.returncode}): "
+                f"{args[0]} run produced no JSON (rc={out.returncode}): "
                 f"{out.stderr[-400:]}"
             )
+        return rec
+
+    def _halo_coalesce_ab():
+        # ISSUE 5 acceptance: the coalesced-vs-per-field A/B with collective
+        # counts + payload bytes read from each variant's optimized HLO.  On
+        # the 1-chip bench backend every partner is a self-copy (no
+        # collectives either way), so the record comes from the virtual
+        # 8-device CPU mesh — the structural counts are the point; the
+        # timing columns are CPU code-path numbers.
+        rec = _cpu_mesh_json(["coalesce", "--n", "32", "--reps", "2"])
+        rec["note"] = (
+            "virtual 8-device CPU mesh: collective counts/payloads are "
+            "structural; t_call_ms is a code-path record, not performance"
+        )
+        return rec
+
+    def _diffusion_grad():
+        # VERDICT weak #6: the gradient-path throughput record
+        # (`fused_with_xla_grad` — fused forward, rematerialized XLA twin
+        # backward), on the real bench backend; docs/performance.md carries
+        # the written row.
+        r = _bench.bench_diffusion_grad(
+            n=256, chunk=8, reps=3, dtype="float32", fused_k=4, emit=False
+        )
+        return {
+            "teff_grad": r["value"], "t_grad_ms": r["t_it_ms"],
+            "t_fwd_ms": r["t_fwd_ms"], "grad_over_fwd": r["grad_over_fwd"],
+        }
+
+    _extra("halo_coalesce_ab", _halo_coalesce_ab)
+    _extra("diffusion_grad_fused4", _diffusion_grad)
+
+    def _weak_codepath():
+        # VERDICT r4 missing #2(a): the virtual-mesh weak-scaling CODE-PATH
+        # record, in the driver artifact itself (see `_cpu_mesh_json` for
+        # why a subprocess, and why the ratio is NOT a performance number).
+        rec = _cpu_mesh_json(["weak", "--n", "16", "--chunk", "4",
+                              "--reps", "2"])
         rec["note"] = (
             "virtual 8-device CPU mesh CODE-PATH record: one core timeshares "
             "all devices, the efficiency ratio is NOT a performance number"

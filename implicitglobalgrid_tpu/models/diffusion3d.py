@@ -406,10 +406,10 @@ def make_multi_step(
 
         def fused_zpatch_step(T, Cp):
             from ..ops.halo import (
+                _T_AXES,
                 apply_z_patch,
                 apply_z_patch_t,
-                exchange_dims,
-                exchange_dims_t,
+                exchange_dims_multi,
                 identity_z_patch,
                 identity_z_patch_t,
                 ol,
@@ -431,17 +431,20 @@ def make_multi_step(
                 # exports the next group's send slabs (round 4: extraction
                 # outside the kernel paid whole-array relayouts per group);
                 # x/y slabs exchange outside (cheap DUS) for both T and the
-                # packed export (corner semantics), then the z communication
-                # runs on the packed array alone.
+                # packed export IN ONE COALESCED PASS (one permute pair per
+                # dim for the pair of fields; corner semantics preserved),
+                # then the z communication runs on the packed array alone.
                 T, zex = fused_diffusion_steps(
                     T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch,
                     z_export=True, z_overlap=o_z,
                 )
-                T = exchange_dims(T, (0, 1), width=fused_k)
                 if tr:
-                    zex = exchange_dims_t(zex, width=fused_k, shape=shape)
+                    T, zex = exchange_dims_multi(
+                        (T, zex), (0, 1), width=fused_k,
+                        logicals=(None, shape), axes=(None, _T_AXES),
+                    )
                     return T, z_patch_from_export_t(zex, width=fused_k)
-                zex = exchange_dims(zex, (0, 1), width=fused_k)
+                T, zex = exchange_dims_multi((T, zex), (0, 1), width=fused_k)
                 return T, z_patch_from_export(zex, width=fused_k)
 
             mk_ident = identity_z_patch_t if tr else identity_z_patch

@@ -251,6 +251,13 @@ def _exchange_dim(A, d: int, gg, width: int = 1, logical=None, axis=None) -> "ja
     vals = _slab_recv_values(A, d, gg, width, logical, axis=axis)
     if vals is None:
         return A
+    return _apply_recv(A, d, vals, width, logical=logical, axis=axis)
+
+
+def _apply_recv(A, d: int, vals, width: int, logical=None, axis=None):
+    """Write a dim-``d`` exchange's received ``(lo_vals, hi_vals)`` slabs
+    into ``A``'s halo planes — the write half of `_exchange_dim`, shared
+    with the multi-field exchange paths."""
     lo_vals, hi_vals = vals
     shp = logical if logical is not None else tuple(A.shape)
     ax = d if axis is None else axis
@@ -287,25 +294,18 @@ def _patch_slab(slab, d: int, start: int, width: int, received, shp):
     return slab
 
 
-def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None,
-                      received=None):
-    """The two slabs a ``d``-exchange of ``A`` would write, without writing.
+def _slab_parts(A, d: int, gg, width: int = 1, logical=None, axis=None,
+                received=None):
+    """The slabs a ``d``-exchange of ``A`` involves, without communicating.
 
-    Returns ``(lo_vals, hi_vals)`` — the values destined for planes
-    ``[0, width)`` and ``[n-width, n)`` (``n`` from ``logical`` when given)
-    — or ``None`` when the dimension exchanges nothing for this field.
-    `_exchange_dim` is get-values + two `_set_plane`s; the fused kernels'
-    z-patch path (`z_slab_patches`) uses the values directly, applying them
-    in VMEM where the minor-dim plane surgery is free (see
-    docs/performance.md's exchanged-dimension anisotropy note).
-
-    ``received`` (the `begin_slab_exchange` path): earlier dims' receive
-    slabs, patched into this dim's send/keep slabs via `_patch_slab` so the
-    sends equal those sliced from a sequentially-updated array.
+    Returns ``None`` when the dimension exchanges nothing for this field,
+    ``("self", lo_vals, hi_vals)`` on the self-partner fast path (a pure
+    local copy needs no transport), or ``("permute", send_lo, send_hi,
+    keep_lo, keep_hi)`` — the two eager send slabs plus the PROC_NULL
+    keep-old slabs as thunks (built only when a non-periodic edge needs
+    masking).  The communication half lives in `_permute_slabs`
+    (per-field) and `_coalesced_permute` (packed multi-field).
     """
-    import jax.numpy as jnp
-    from jax import lax
-
     shp = logical if logical is not None else tuple(A.shape)  # local block shape
     ax = d if axis is None else axis  # array axis carrying grid dim d's data
     if d >= len(shp):
@@ -316,9 +316,6 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None,
     if o < 2:
         return None  # no halo in this dimension (reference: update_halo.jl:369)
     n = shp[d]
-    nd = gg.dims[d]
-    periodic = bool(gg.periods[d])
-    disp = int(gg.disp)
     if not dim_has_halo_activity(gg, d):
         # No partners at all: dims==1 non-periodic, or every distance-disp
         # shift falls off the grid (all partners PROC_NULL).
@@ -349,6 +346,7 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None,
         # reference's self-neighbor fast path generalized, or disp==0):
         # pure local copy (reference: update_halo.jl:57-63).
         return (
+            "self",
             slab(n - o),      # -> planes [0, width)
             slab(o - width),  # -> planes [n-width, n)
         )
@@ -356,12 +354,40 @@ def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None,
     # Slabs go to the lower partner's top ``width`` planes / the upper
     # partner's bottom ``width`` planes (reference sendranges/recvranges,
     # generalized from one plane to a slab).
+    return (
+        "permute",
+        slab(o - width),
+        slab(n - o),
+        lambda: slab(0),
+        lambda: slab(n - width),
+    )
+
+
+def _slab_recv_values(A, d: int, gg, width: int = 1, logical=None, axis=None,
+                      received=None):
+    """The two slabs a ``d``-exchange of ``A`` would write, without writing.
+
+    Returns ``(lo_vals, hi_vals)`` — the values destined for planes
+    ``[0, width)`` and ``[n-width, n)`` (``n`` from ``logical`` when given)
+    — or ``None`` when the dimension exchanges nothing for this field.
+    `_exchange_dim` is get-values + two `_set_plane`s; the fused kernels'
+    z-patch path (`z_slab_patches`) uses the values directly, applying them
+    in VMEM where the minor-dim plane surgery is free (see
+    docs/performance.md's exchanged-dimension anisotropy note).
+
+    ``received`` (the `begin_slab_exchange` path): earlier dims' receive
+    slabs, patched into this dim's send/keep slabs via `_patch_slab` so the
+    sends equal those sliced from a sequentially-updated array.
+    """
+    p = _slab_parts(A, d, gg, width, logical, axis, received)
+    if p is None:
+        return None
+    if p[0] == "self":
+        return p[1], p[2]
+    _, send_lo, send_hi, keep_lo, keep_hi = p
     return _permute_slabs(
-        gg, d,
-        send_lo=slab(o - width),
-        send_hi=slab(n - o),
-        keep_lo=lambda: slab(0),
-        keep_hi=lambda: slab(n - width),
+        gg, d, send_lo=send_lo, send_hi=send_hi, keep_lo=keep_lo,
+        keep_hi=keep_hi,
     )
 
 
@@ -421,15 +447,252 @@ def _permute_slabs(gg, d: int, *, send_lo, send_hi, keep_lo, keep_hi):
     )
 
 
-def _update_halo_local(fields: tuple, gg, width: int = 1) -> tuple:
-    """Per-block exchange of all fields, dimensions strictly in order x→y→z."""
+# --- Coalesced multi-field transport (message combining) ---------------------
+#
+# One `collective-permute` pair per (dimension, dtype byte width) instead of
+# one per field: every participating field's send slab is flattened to its
+# same-width unsigned-int words (the chunked gather's byte-exact transport,
+# `ops.gather._block_fetch_fn` — f32/bf16/-0.0/NaN payloads survive because
+# bitcasting is arithmetic-free), the flat words concatenate into one buffer
+# per byte width, the packed buffers ride `_permute_slabs` (same partner
+# permutation, same PROC_NULL whole-word masking), and the received buffer
+# splits/bitcasts back into per-field slabs.  Fewer, fatter hops: the
+# per-hop latency of a collective amortizes over every field of the step —
+# the reference's own pipelining advice taken one level further
+# (`/root/reference/src/update_halo.jl:13-14`).
+
+
+def _word_width(dtype) -> int:
+    """Transport word size in bytes (complex splits into two float words)."""
+    dt = np.dtype(dtype)
+    return dt.itemsize // 2 if dt.kind == "c" else dt.itemsize
+
+
+def _flat_words(x):
+    """Flatten ``x`` to its same-width unsigned-int words, byte-exactly.
+
+    bool cannot `bitcast_convert_type`; its {0,1} values convert to uint8
+    exactly (and back), which is just as byte-faithful for a transport.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .gather import _word_dtype
+
+    if x.dtype == jnp.bool_.dtype:
+        return x.reshape(-1).astype(jnp.uint8)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        x = jnp.stack((x.real, x.imag), axis=-1)
+    return lax.bitcast_convert_type(x, _word_dtype(x.dtype)).reshape(-1)
+
+
+def _from_words(buf, shape, dtype):
+    """Invert `_flat_words`: words back to an array of ``shape``/``dtype``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    dt = jnp.dtype(dtype)
+    if dt == jnp.bool_.dtype:
+        return buf.reshape(tuple(shape)).astype(dt)
+    if jnp.issubdtype(dt, jnp.complexfloating):
+        ft = jnp.finfo(dt).dtype
+        comp = lax.bitcast_convert_type(buf.reshape(tuple(shape) + (2,)), ft)
+        return lax.complex(comp[..., 0], comp[..., 1])
+    return lax.bitcast_convert_type(buf.reshape(tuple(shape)), dt)
+
+
+def _coalesced_permute(gg, d: int, parts):
+    """`_permute_slabs` for several fields at once: one ppermute pair per
+    dtype byte-width group instead of one per field.
+
+    ``parts``: per-field ``(send_lo, send_hi, keep_lo, keep_hi)`` tuples
+    (keeps as thunks, `_slab_parts`).  Returns per-field ``(lo_vals,
+    hi_vals)`` BIT-identical to the per-field path: the packed buffer moves
+    the same words, the PROC_NULL mask picks whole words with the same
+    per-dim predicate, and the bitcast round trip is arithmetic-free.  A
+    width group with a single member skips the packing (nothing to combine
+    — same collectives either way, no relayout paid).
+
+    Autodiff: `lax.bitcast_convert_type` has no tangent, so the packed
+    transport carries a custom VJP that differentiates the PER-FIELD
+    transport instead (`_packed_transport` — the `fused_with_xla_grad`
+    pattern): both move the identical values field-for-field, so the
+    per-field path's exact ppermute/where transpose IS the packed path's
+    transpose.  Without it, `jax.grad` through a coalesced exchange would
+    silently drop every cotangent that crosses a block boundary.
+    """
+    periodic = bool(gg.periods[d])
+    sends_lo = tuple(p[0] for p in parts)
+    sends_hi = tuple(p[1] for p in parts)
+    if periodic:
+        # Keep slabs are only ever read by the PROC_NULL mask of
+        # non-periodic dims; do not materialize them elsewhere.
+        keeps_lo = keeps_hi = ()
+    else:
+        keeps_lo = tuple(p[2]() for p in parts)
+        keeps_hi = tuple(p[3]() for p in parts)
+    los, his = _packed_transport(gg, d)(sends_lo, sends_hi, keeps_lo, keeps_hi)
+    return [(lo, hi) for lo, hi in zip(los, his)]
+
+
+def _keep_thunks(keeps_lo, keeps_hi, j: int):
+    """keep_lo/keep_hi thunk kwargs for field ``j`` (dummies on periodic
+    dims, where `_permute_slabs` never invokes them)."""
+    if not keeps_lo:
+        return dict(keep_lo=lambda: None, keep_hi=lambda: None)
+    return dict(keep_lo=lambda: keeps_lo[j], keep_hi=lambda: keeps_hi[j])
+
+
+def _packed_transport(gg, d: int):
+    """The width-group packed transport as a differentiable function of the
+    per-field send/keep slabs.  Primal: bitcast-pack per byte width, one
+    `_permute_slabs` pair per group.  VJP: `jax.vjp` of the per-field
+    transport over the same operands (value-identical by the coalescing
+    contract, and built from primitives with exact transpose rules)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..utils import telemetry as _telemetry
+
+    def packed(sends_lo, sends_hi, keeps_lo, keeps_hi):
+        groups: dict[int, list[int]] = {}
+        for j, s in enumerate(sends_lo):
+            groups.setdefault(_word_width(s.dtype), []).append(j)
+        los: list = [None] * len(sends_lo)
+        his: list = [None] * len(sends_lo)
+        for wbytes, idxs in sorted(groups.items()):
+            if len(idxs) == 1:
+                (j,) = idxs
+                los[j], his[j] = _permute_slabs(
+                    gg, d, send_lo=sends_lo[j], send_hi=sends_hi[j],
+                    **_keep_thunks(keeps_lo, keeps_hi, j),
+                )
+                continue
+            flats_lo = [_flat_words(sends_lo[j]) for j in idxs]
+            flats_hi = [_flat_words(sends_hi[j]) for j in idxs]
+            sizes = [int(f.shape[0]) for f in flats_lo]
+            buf_lo = jnp.concatenate(flats_lo)
+            buf_hi = jnp.concatenate(flats_hi)
+            # Trace-time counters (like `halo.begin_slab_traces`): coalesced
+            # exchanges are built into compiled programs, so these count
+            # traced collectives and their per-hop payload bytes
+            # (docs/observability.md).
+            _telemetry.counter("halo.coalesced_collectives").inc(2)
+            _telemetry.counter("halo.coalesced_bytes").inc(
+                2 * int(buf_lo.shape[0]) * wbytes
+            )
+            recv_lo, recv_hi = _permute_slabs(
+                gg, d,
+                send_lo=buf_lo,
+                send_hi=buf_hi,
+                keep_lo=lambda: jnp.concatenate(
+                    [_flat_words(keeps_lo[j]) for j in idxs]
+                ),
+                keep_hi=lambda: jnp.concatenate(
+                    [_flat_words(keeps_hi[j]) for j in idxs]
+                ),
+            )
+            off = 0
+            for j, size in zip(idxs, sizes):
+                shape, dtype = sends_lo[j].shape, sends_lo[j].dtype
+                los[j] = _from_words(recv_lo[off : off + size], shape, dtype)
+                his[j] = _from_words(recv_hi[off : off + size], shape, dtype)
+                off += size
+        return tuple(los), tuple(his)
+
+    def per_field(sends_lo, sends_hi, keeps_lo, keeps_hi):
+        outs = [
+            _permute_slabs(
+                gg, d, send_lo=sends_lo[j], send_hi=sends_hi[j],
+                **_keep_thunks(keeps_lo, keeps_hi, j),
+            )
+            for j in range(len(sends_lo))
+        ]
+        return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+    f = jax.custom_vjp(packed)
+
+    def fwd(*ops):
+        return packed(*ops), ops
+
+    def bwd(ops, g):
+        _, vjp = jax.vjp(per_field, *ops)
+        return vjp(g)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _multi_slab_recv_values(fields, d: int, gg, width: int = 1, logicals=None,
+                            axes=None, receiveds=None, coalesce: bool = True):
+    """Per-field ``(lo_vals, hi_vals)`` of a dim-``d`` exchange of a field
+    LIST — `_slab_recv_values` over several fields, with the collectives
+    coalesced across fields (`_coalesced_permute`) when ``coalesce`` is on
+    and at least two fields actually permute.  Entries are ``None`` where a
+    field skips the dimension; ``axes[i]``/``logicals[i]``/``receiveds[i]``
+    as in `_slab_recv_values`."""
+    n = len(fields)
+    logicals = (None,) * n if logicals is None else tuple(logicals)
+    axes = (None,) * n if axes is None else tuple(axes)
+    receiveds = (None,) * n if receiveds is None else tuple(receiveds)
+    out: list = [None] * n
+    permuting: list = []
+    for i, A in enumerate(fields):
+        p = _slab_parts(A, d, gg, width, logicals[i], axes[i], receiveds[i])
+        if p is None:
+            continue
+        if p[0] == "self":
+            out[i] = (p[1], p[2])
+        else:
+            permuting.append((i, p[1:]))
+    if coalesce and len(permuting) >= 2:
+        vals = _coalesced_permute(gg, d, [p for _, p in permuting])
+        for (i, _), v in zip(permuting, vals):
+            out[i] = v
+    else:
+        for i, (send_lo, send_hi, keep_lo, keep_hi) in permuting:
+            out[i] = _permute_slabs(
+                gg, d, send_lo=send_lo, send_hi=send_hi, keep_lo=keep_lo,
+                keep_hi=keep_hi,
+            )
+    return out
+
+
+def _default_coalesce() -> bool:
+    """``IGG_COALESCE`` env default for the multi-field exchange paths.
+
+    Unset = auto (coalesce whenever >= 2 fields share a dimension's
+    exchange — it is bit-identical, so the only reason to stay per-field
+    is debugging/attribution); ``0`` restores per-field collectives;
+    nonzero forces the auto behavior explicitly.  Read per call/trace,
+    like ``IGG_DONATE``.
+    """
+    from ..utils.config import coalesce_env
+
+    val = coalesce_env()
+    return True if val is None else val
+
+
+def _update_halo_local(fields: tuple, gg, width: int = 1,
+                       coalesce: bool | None = None) -> tuple:
+    """Per-block exchange of all fields, dimensions strictly in order x→y→z.
+
+    ``coalesce`` (None = `IGG_COALESCE` env, default auto): pack every
+    field's send slab into one buffer per dtype byte width and issue ONE
+    collective-permute pair per (dimension, width group) instead of one per
+    field (`_coalesced_permute`) — bit-identical, fewer/fatter hops.
+    """
     from ..utils.compat import named_scope
 
+    if coalesce is None:
+        coalesce = _default_coalesce()
     out = list(fields)
     with named_scope("igg_halo_exchange"):
         for d in range(NDIMS):
-            for i in range(len(out)):
-                out[i] = _exchange_dim(out[i], d, gg, width)
+            vals = _multi_slab_recv_values(out, d, gg, width, coalesce=coalesce)
+            for i, v in enumerate(vals):
+                if v is not None:
+                    out[i] = _apply_recv(out[i], d, v, width)
     return tuple(out)
 
 
@@ -533,7 +796,13 @@ def apply_z_patch_t(A, patch_t, *, width: int = 1):
     return _set_plane(A, hi, n - width, 2)
 
 
-def exchange_dims_t(E, *, width: int, shape):
+#: Array-axis map of the transposed z-patch/export layout: grid dim 0's
+#: slabs live on array axis 0 (as usual), grid dim 1's on array axis 2
+#: (the ``axes`` override of `exchange_dims_multi`).
+_T_AXES = {0: 0, 1: 2}
+
+
+def exchange_dims_t(E, *, width: int, shape, coalesce=None):
     """x/y-exchange a TRANSPOSED z-patch/export array ``(n0, P, n1p)``.
 
     Grid dim 0's slabs live on array axis 0 (as usual); grid dim 1's live on
@@ -542,9 +811,11 @@ def exchange_dims_t(E, *, width: int, shape):
     carries the sequential-dimension corner semantics exactly like the
     packed layout's `exchange_dims`.
     """
-    gg = _grid.global_grid()
-    E = _exchange_dim(E, 0, gg, width, logical=shape, axis=0)
-    return _exchange_dim(E, 1, gg, width, logical=shape, axis=2)
+    (E,) = exchange_dims_multi(
+        (E,), (0, 1), width=width, logicals=(shape,), axes=(_T_AXES,),
+        coalesce=coalesce,
+    )
+    return E
 
 
 def z_patch_from_export_t(export_t, *, width: int):
@@ -585,10 +856,43 @@ def exchange_dims(A, dims, *, width: int = 1, logical=None):
     return A
 
 
+def exchange_dims_multi(fields, dims, *, width: int = 1, logicals=None,
+                        axes=None, coalesce: bool | None = None):
+    """Exchange SEVERAL fields along the given dimensions in one pass — the
+    multi-field `exchange_dims`, with each dimension's collectives coalesced
+    across fields (one `collective-permute` pair per (dimension, dtype byte
+    width); ``coalesce`` None = the ``IGG_COALESCE`` env default, auto-on).
+
+    ``logicals[i]``: field ``i``'s REAL shape for padded layouts; ``axes[i]``:
+    an optional ``{grid dim: array axis}`` map for transposed layouts
+    (`exchange_dims_t`'s y-on-axis-2).  Dimensions run strictly in the given
+    order, each seeing the previous dims' updated halos — the sequential-
+    dimension corner semantics, unchanged.  Traced-context only, like
+    `exchange_dims`.
+    """
+    gg = _grid.global_grid()
+    if coalesce is None:
+        coalesce = _default_coalesce()
+    n = len(fields)
+    logicals = (None,) * n if logicals is None else tuple(logicals)
+    axes = (None,) * n if axes is None else tuple(axes)
+    out = list(fields)
+    for d in dims:
+        axs = [None if a is None else a.get(d) for a in axes]
+        vals = _multi_slab_recv_values(
+            out, d, gg, width, logicals, axs, coalesce=coalesce
+        )
+        for i, v in enumerate(vals):
+            if v is not None:
+                out[i] = _apply_recv(out[i], d, v, width, logicals[i], axs[i])
+    return tuple(out)
+
+
 # --- Early-dispatch slab exchange (pipelined group schedule) ----------------
 
 
-def begin_slab_exchange(fields, dims, *, width: int, logicals=None):
+def begin_slab_exchange(fields, dims, *, width: int, logicals=None,
+                        coalesce=None):
     """Start the slab exchange of ``fields`` along ``dims`` WITHOUT writing
     the received planes back.
 
@@ -607,7 +911,11 @@ def begin_slab_exchange(fields, dims, *, width: int, logicals=None):
     owned values is bit-identical to the serialized exchange
     (`exchange_dims` / `update_halo_padded_faces`) over the same dims.
     ``logicals``: per-field REAL shapes for padded layouts (as in
-    `_exchange_dim`).  Traced-context only, like `exchange_dims`.
+    `_exchange_dim`).  ``coalesce`` (None = ``IGG_COALESCE``): pack each
+    dimension's send slabs across fields into one collective-permute pair
+    per dtype byte width — each field's sends depend only on its OWN
+    earlier-dim receive strips, so the dim-major packing moves exactly the
+    per-field values.  Traced-context only, like `exchange_dims`.
     """
     from ..utils import telemetry as _telemetry
     from ..utils.compat import named_scope
@@ -615,24 +923,25 @@ def begin_slab_exchange(fields, dims, *, width: int, logicals=None):
     gg = _grid.global_grid()
     if logicals is None:
         logicals = (None,) * len(fields)
+    if coalesce is None:
+        coalesce = _default_coalesce()
     # Trace-time counter: begin/finish calls run while BUILDING a program
     # (the early-dispatch exchange shape), so this counts traced schedules,
     # not runtime executions (docs/observability.md).
     _telemetry.counter("halo.begin_slab_traces").inc()
-    pends = []
+    receiveds: list[dict] = [{} for _ in fields]
+    pends: list[list] = [[] for _ in fields]
     with named_scope("igg_slab_exchange_begin"):
-        for A, logical in zip(fields, logicals):
-            received: dict = {}
-            pend = []
-            for d in dims:
-                vals = _slab_recv_values(
-                    A, d, gg, width, logical, received=received
-                )
-                if vals is None:
+        for d in dims:
+            vals = _multi_slab_recv_values(
+                fields, d, gg, width, logicals, receiveds=receiveds,
+                coalesce=coalesce,
+            )
+            for i, v in enumerate(vals):
+                if v is None:
                     continue
-                received[d] = vals
-                pend.append((d, vals[0], vals[1]))
-            pends.append(pend)
+                receiveds[i][d] = v
+                pends[i].append((d, v[0], v[1]))
     return pends
 
 
@@ -675,21 +984,31 @@ def z_patch_from_export(export, *, width: int):
     Must run AFTER the x/y exchanges of the export (sequential-dimension
     corner semantics ride the packed array).
     """
-    import jax.numpy as jnp
-
     gg = _grid.global_grid()
     w = width
     if _partner_self(gg, 2):
         # Lanes [0,2w) are already the patch (send-hi -> planes [0,w),
         # send-lo -> the top w planes) — the self-neighbor fast path.
         return export
-    recv_lo, recv_hi = _permute_slabs(
-        gg, 2,
+    recv_lo, recv_hi = _permute_slabs(gg, 2, **_z_export_slabs(export, w))
+    return _pack_recv_patch(recv_lo, recv_hi, w)
+
+
+def _z_export_slabs(export, w: int) -> dict:
+    """The send/keep slab kwargs of one packed z export's z communication
+    (export lane layout: see `z_patch_from_export`)."""
+    return dict(
         send_lo=export[:, :, w : 2 * w],
         send_hi=export[:, :, 0:w],
         keep_lo=lambda: export[:, :, 2 * w : 3 * w],
         keep_hi=lambda: export[:, :, 3 * w : 4 * w],
     )
+
+
+def _pack_recv_patch(recv_lo, recv_hi, w: int):
+    """Received z slabs -> the next group's 128-lane patch layout."""
+    import jax.numpy as jnp
+
     packed = jnp.concatenate([recv_lo, recv_hi], axis=2)
     return jnp.pad(packed, ((0, 0), (0, 0), (0, 128 - 2 * w)))
 
@@ -753,7 +1072,7 @@ def fix_topface_z_exports(exports, C, Axp, Ayp, Azp, *, width: int):
     return exp_cz, exp_x, exp_y
 
 
-def z_patches_from_exports(exports, C_shape, *, width: int):
+def z_patches_from_exports(exports, C_shape, *, width: int, coalesce=None):
     """x/y-exchange the three packed z exports (real-shape slab indices via
     ``logical``) and turn each into the next group's patch — the multi-field
     z communication of the staggered z-slab cadence, all on packed arrays.
@@ -762,29 +1081,51 @@ def z_patches_from_exports(exports, C_shape, *, width: int):
     z-face field staggers only in z); its z communication runs per lane
     band in the non-self case, and the self-partner fast path hands the
     whole merged array back untouched.
+
+    Coalesced by default (``IGG_COALESCE``): the three exports' x/y hops
+    combine into one permute pair per dimension, and the non-self z hops
+    of all four lane bands (cell, z-face, x-face, y-face) pack into ONE
+    pair — 2 collectives for the whole staggered family's z exchange
+    instead of 8 (the residual VERDICT r5 names behind the porous
+    periodic-z gap).
     """
     n0, n1, _ = C_shape
-    exp_cz, exp_x, exp_y = exports
     w = width
     gg = _grid.global_grid()
+    if coalesce is None:
+        coalesce = _default_coalesce()
 
-    exp_cz = exchange_dims(exp_cz, (0, 1), width=w)
+    exp_cz, exp_x, exp_y = exchange_dims_multi(
+        exports, (0, 1), width=w,
+        logicals=(None, (n0 + 1, n1, 128), (n0, n1 + 1, 128)),
+        coalesce=coalesce,
+    )
     if _partner_self(gg, 2):
-        patch_cz = exp_cz  # bands [L, L+2w) are already the patches
-    else:
-        cell = z_patch_from_export(exp_cz[:, :, :Z_CZ_BAND], width=w)
-        zf = z_patch_from_export(
-            exp_cz[:, :, Z_CZ_BAND : Z_CZ_BAND + 4 * w], width=w
+        # Bands [L, L+2w) are already the patches (`z_patch_from_export`'s
+        # self-partner fast path, applied to all three).
+        return exp_cz, exp_x, exp_y
+    bands = (
+        exp_cz[:, :, :Z_CZ_BAND],
+        exp_cz[:, :, Z_CZ_BAND : Z_CZ_BAND + 4 * w],
+        exp_x,
+        exp_y,
+    )
+    slabs = [_z_export_slabs(b, w) for b in bands]
+    if coalesce:
+        vals = _coalesced_permute(
+            gg, 2,
+            [(s["send_lo"], s["send_hi"], s["keep_lo"], s["keep_hi"])
+             for s in slabs],
         )
-        patch_cz = _pack_cz(cell, zf)
-    out = [patch_cz]
-    for e, lg in ((exp_x, (n0 + 1, n1, 128)), (exp_y, (n0, n1 + 1, 128))):
-        e = exchange_dims(e, (0, 1), width=w, logical=lg)
-        out.append(z_patch_from_export(e, width=w))
-    return tuple(out)
+    else:
+        vals = [_permute_slabs(gg, 2, **s) for s in slabs]
+    cell, zf, patch_x, patch_y = (
+        _pack_recv_patch(lo, hi, w) for lo, hi in vals
+    )
+    return _pack_cz(cell, zf), patch_x, patch_y
 
 
-def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1):
+def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1, coalesce=None):
     """The z-dimension exchange of the four fields, as packed patch arrays.
 
     Returns ``(patch_CAz, patch_Ax, patch_Ay)`` (`_pack_z_patch` layout;
@@ -799,12 +1140,13 @@ def z_slab_patches(C, Axp, Ayp, Azp, *, width: int = 1):
     """
     gg = _grid.global_grid()
     logicals = _padded_logicals(C, Axp, Ayp, Azp)
-    packed = []
-    for A, logical in zip((C, Axp, Ayp, Azp), logicals):
-        vals = _slab_recv_values(A, 2, gg, width, logical)
-        if vals is None:
-            return None  # all-or-nothing: z activity is per-grid, not per-field
-        packed.append(_pack_z_patch(*vals, width))
+    vals = _multi_slab_recv_values(
+        (C, Axp, Ayp, Azp), 2, gg, width, logicals,
+        coalesce=_default_coalesce() if coalesce is None else coalesce,
+    )
+    if any(v is None for v in vals):
+        return None  # all-or-nothing: z activity is per-grid, not per-field
+    packed = [_pack_z_patch(*v, width) for v in vals]
     return (_pack_cz(packed[0], packed[3]), packed[1], packed[2])
 
 
@@ -848,7 +1190,8 @@ def apply_z_patches(C, Axp, Ayp, Azp, patches, *, width: int = 1):
     return tuple(out)
 
 
-def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1, dims=None):
+def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1, dims=None,
+                             coalesce=None):
     """Slab-exchange a cell field + three `pad_faces`-layout staggered fields.
 
     The models' fused deep-halo cadences keep the staggered fields in the
@@ -862,18 +1205,21 @@ def update_halo_padded_faces(C, Axp, Ayp, Azp, *, width: int = 1, dims=None):
 
     ``dims``: restrict the exchange to these dimensions (default all) — the
     z-patch cadence exchanges x/y here and routes z through `z_slab_patches`
-    into the kernel.
+    into the kernel.  ``coalesce``: the four fields' collectives combine
+    into one permute pair per (dimension, dtype width) by default
+    (`exchange_dims_multi`; ``IGG_COALESCE=0`` restores per-field hops).
 
     Tracer-context only (inside `stencil`/shard_map — where the fused block
     steps live); the public `update_halo` remains the global-array entry.
     """
-    gg = _grid.global_grid()
     logicals = _padded_logicals(C, Axp, Ayp, Azp)
-    out = [C, Axp, Ayp, Azp]
-    for d in range(NDIMS) if dims is None else dims:
-        for i in range(len(out)):
-            out[i] = _exchange_dim(out[i], d, gg, width, logical=logicals[i])
-    return tuple(out)
+    return exchange_dims_multi(
+        (C, Axp, Ayp, Azp),
+        tuple(range(NDIMS)) if dims is None else dims,
+        width=width,
+        logicals=logicals,
+        coalesce=coalesce,
+    )
 
 
 def _exchange_slab_bytes(fields, gg, width: int) -> int:
@@ -917,12 +1263,13 @@ def _default_donate() -> bool:
     return True if val is None else val > 0
 
 
-def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True):
+def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True,
+                      coalesce: bool = True):
     """Build (and cache) the jitted shard_map wrapper for one field signature."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    key = (gg.epoch, shapes_dtypes, width, donate)
+    key = (gg.epoch, shapes_dtypes, width, donate, coalesce)
     fn = _jit_cache.get(key)
     if fn is not None:
         return fn
@@ -930,7 +1277,7 @@ def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True):
     dn = tuple(range(len(ndims_per_field))) if donate else ()
 
     def exchange(*fields):
-        return _update_halo_local(fields, gg, width)
+        return _update_halo_local(fields, gg, width, coalesce)
 
     if gg.nprocs == 1 and not gg.force_spmd:
         # 1-device grid: only self-neighbor local copies remain (no ppermute,
@@ -950,14 +1297,23 @@ def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True):
     return fn
 
 
-def update_halo(*fields, width: int = 1, donate: bool | None = None):
+def update_halo(*fields, width: int = 1, donate: bool | None = None,
+                coalesce: bool | None = None):
     """Update the halo planes of the given field(s).
 
     TPU-native counterpart of `update_halo!` (`/root/reference/src/update_halo.jl:25-78`).
     Functional: returns the updated field(s) — a single array for one argument,
     a tuple for several.  Pass all fields of a time step in one call so XLA
     compiles one fused program (the reference's pipelining advice,
-    `/root/reference/src/update_halo.jl:13-14`).
+    `/root/reference/src/update_halo.jl:13-14`) — and so their collectives
+    COALESCE: by default every field's send slab packs into one flat buffer
+    per dtype byte width and each exchanged dimension issues ONE
+    `collective-permute` pair per width group instead of one per field
+    (message combining; bit-identical — the transport bitcasts to same-width
+    unsigned ints, like the chunked gather).  ``coalesce=False`` (or
+    ``IGG_COALESCE=0``) restores per-field collectives; ``coalesce=None``
+    takes the env default (auto: combine whenever >= 2 fields share a
+    dimension's exchange).
 
     ``width``: halo planes refreshed per side (default 1 = the reference's
     exchange).  ``width=w`` on a deep-halo grid (``overlap >= 2w``) refreshes
@@ -991,7 +1347,7 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None):
                 "fields to be local-block tracers; pass captured global-block "
                 "fields as arguments of the stencil function instead."
             )
-        out = _update_halo_local(tuple(fields), gg, width)
+        out = _update_halo_local(tuple(fields), gg, width, coalesce)
     else:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1004,6 +1360,8 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None):
         sig = tuple((local_shape(A, gg), str(A.dtype)) for A in arrs)
         if donate is None:
             donate = _default_donate()
+        if coalesce is None:
+            coalesce = _default_coalesce()
         from ..utils import telemetry as _telemetry
 
         if _telemetry.enabled():
@@ -1014,7 +1372,7 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None):
             _telemetry.counter("halo.fields").inc(len(arrs))
             _telemetry.counter("halo.bytes").inc(nbytes)
             _telemetry.histogram("halo.slab_bytes").record(nbytes)
-        out = _global_update_fn(gg, sig, width, bool(donate))(*arrs)
+        out = _global_update_fn(gg, sig, width, bool(donate), bool(coalesce))(*arrs)
         if _post_exchange_hook is not None:
             out = tuple(_post_exchange_hook(tuple(out)))
     return out[0] if len(fields) == 1 else tuple(out)

@@ -19,6 +19,15 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_DONATE``            default for `update_halo`'s global-array buffer
                           donation (0 = off; see `ops.halo._default_donate`
                           — read per call, not at init)
+``IGG_COALESCE``          multi-field halo-exchange message combining
+                          (``ops.halo``): unset = auto — whenever >= 2
+                          fields share a dimension's exchange, their send
+                          slabs pack into one buffer per dtype byte width
+                          and ride ONE collective-permute pair per
+                          (dimension, width group); ``0`` restores per-field
+                          collectives (debug/attribution); bit-identical
+                          either way.  Read per call/trace, like
+                          ``IGG_DONATE`` (`ops.halo._default_coalesce`)
 ``IGG_VMEM_MB``           per-core VMEM capacity the fused kernels plan
                           against (`ops._fused_envelope.vmem_budget` — read
                           per kernel build, not at init)
@@ -252,6 +261,18 @@ def fault_inject_env() -> str | None:
     """``IGG_FAULT_INJECT``: raw fault spec (parsed by `utils.resilience`)."""
     val = os.environ.get("IGG_FAULT_INJECT")
     return val or None
+
+
+def coalesce_env() -> bool | None:
+    """``IGG_COALESCE``: multi-field halo-exchange message combining.
+
+    ``None`` = unset (auto: coalesce whenever >= 2 fields share a
+    dimension's exchange), ``False`` = per-field collectives, ``True`` =
+    the auto behavior pinned explicitly.  Bit-identical either way — the
+    knob exists for debugging/per-field attribution and A/B measurement.
+    """
+    val = _int_env("IGG_COALESCE")
+    return None if val is None else val > 0
 
 
 def gather_batch_env() -> int | None:

@@ -1,9 +1,19 @@
-"""Worker process for the 2-process `jax.distributed` test (not a pytest file).
+"""Worker process for the multi-process `jax.distributed` tests (not pytest).
 
-Spawned by `tests/test_distributed.py`: 2 processes x 4 virtual CPU devices
-each = the same 8-device mesh the rest of the suite uses, but with a real
-process boundary through it — the TPU translation of the reference running
-its suite under ``mpiexec -n N`` (`/root/reference/test/runtests.jl:8-31`).
+Spawned by `tests/test_distributed.py` as ``(pid, nprocs, port, out_path[,
+mesh])``: ``nprocs`` coordinator-connected processes share one virtual CPU
+mesh of shape ``mesh`` (``"DXxDYxDZ"``, default ``2x2x2`` = the suite's
+8-device mesh; each process hosts ``prod(mesh)/nprocs`` virtual devices)
+with real process boundaries through it — the TPU translation of the
+reference running its suite under ``mpiexec -n N``
+(`/root/reference/test/runtests.jl:8-31`).
+
+The default 2-process shape runs the full battery below.  A non-default
+``mesh`` (e.g. the 4-process ``2x2x1``: one device per process, TWO
+simultaneous process boundaries) runs the compact scenario: fused-cadence
+exchange + fill-in-place gather with corner carry-over across both
+boundaries, plus coalesced-vs-per-field exchange bit-identity on real gloo
+hops (ISSUE 5).
 
 Covers the paths no single-process test can reach:
 `parallel/distributed.py` (init via `init_global_grid(init_distributed=True)`),
@@ -14,12 +24,19 @@ finalize-shuts-down-the-runtime lifecycle
 (`/root/reference/src/finalize_global_grid.jl:19-23` analogue).
 """
 
+import math
 import sys
 
 pid = int(sys.argv[1])
 nproc = int(sys.argv[2])
 port = sys.argv[3]
 out_path = sys.argv[4]
+mesh_arg = sys.argv[5] if len(sys.argv) > 5 else ""
+MESH_DIMS = (
+    tuple(int(x) for x in mesh_arg.split("x")) if mesh_arg else (2, 2, 2)
+)
+assert math.prod(MESH_DIMS) % nproc == 0, (MESH_DIMS, nproc)
+LOCAL_DEVICES = math.prod(MESH_DIMS) // nproc
 
 import faulthandler
 import os
@@ -32,7 +49,8 @@ faulthandler.dump_traceback_later(270, exit=True)
 # Fresh process: stage the virtual-device count before jax import so older
 # JAX versions (no jax_num_cpu_devices config option) honor it too.
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
 ).strip()
 
 # Telemetry armed for the whole worker run (docs/observability.md): both
@@ -45,7 +63,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 try:
-    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
 except AttributeError:
     pass
 jax.config.update("jax_enable_x64", True)
@@ -81,18 +99,103 @@ me, dims, nprocs, coords, mesh = igg.init_global_grid(
         num_processes=nproc,
         process_id=pid,
     ),
+    **(
+        dict(dimx=MESH_DIMS[0], dimy=MESH_DIMS[1], dimz=MESH_DIMS[2])
+        if mesh_arg
+        else {}
+    ),
 )
 assert dist.is_distributed_initialized()
 assert jax.process_count() == nproc, jax.process_count()
-assert nprocs == 8, nprocs  # 2 processes x 4 devices
+assert nprocs == math.prod(MESH_DIMS), nprocs
+assert tuple(dims) == MESH_DIMS, (dims, MESH_DIMS)
 assert igg.get_global_grid().owns_distributed
 
-# me/coords = the block of this process's FIRST local device; with 4 local
-# devices per process the two processes must disagree.
+# me/coords = the block of this process's FIRST local device; distinct
+# processes must land on distinct blocks.
 assert 0 <= me < nprocs
 assert coords == tuple(
     int(c) for c in np.argwhere(mesh.devices == jax.local_devices()[0])[0]
 )
+
+if mesh_arg:
+    # ------------------------------------------------------------------
+    # Compact multi-boundary scenario (ISSUE 5 satellite): run on the
+    # requested mesh (e.g. 4 processes x 1 device = a 2x2 process grid in
+    # x/y) and exercise exactly the paths where TWO simultaneous process
+    # boundaries matter: the fused production cadence's slab exchange with
+    # sequential-dimension corner carry-over, the fill-in-place chunked
+    # gather, and the coalesced exchange's bit-identity on real gloo hops.
+    # ------------------------------------------------------------------
+    import warnings
+
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.ops import gather as gather_mod
+
+    # Deep-halo grid for the fused cadence (keep the runtime up, like the
+    # reference's finalize_MPI=false re-init cycle).
+    igg.finalize_global_grid(finalize_distributed=False)
+    igg.init_global_grid(
+        NX, NX, NX,
+        dimx=MESH_DIMS[0], dimy=MESH_DIMS[1], dimz=MESH_DIMS[2],
+        overlapx=4, overlapy=4, overlapz=4, quiet=True,
+    )
+
+    # (1) Corner carry-over + coalesced bit-identity across both process
+    # boundaries: on a coordinate-derived field set, duplicated cells are
+    # consistent by construction, so a CORRECT width-2 multi-field slab
+    # exchange is a bitwise no-op — any wrong plane, offset, partner or
+    # corner strip breaks it.  Run it coalesced AND per-field: both must be
+    # no-ops, hence bit-identical to each other over the real gloo hops.
+    state, params = diffusion3d.setup(NX, NX, NX, init_grid=False)
+    T0, Cp0 = state[0], state[1]
+    fields = (T0, Cp0, T0.astype(jnp.float32), Cp0.astype(jnp.float32))
+    maxdiff = jax.jit(lambda a, b: jnp.max(jnp.abs(a - b)))
+    for coalesce in (True, False):
+        outs = igg.update_halo(
+            *[f + 0 for f in fields], width=2, coalesce=coalesce
+        )
+        for f, o in zip(fields, outs):
+            d = float(maxdiff(o, f))
+            assert d == 0.0, (
+                f"width-2 slab exchange (coalesce={coalesce}) not a no-op "
+                f"on a consistent field across the 2x2 process grid: {d}"
+            )
+
+    # (2) The fused production cadence (f64 grid: the documented warn-once
+    # XLA fallback at the kernel path's exact exchange schedule) across
+    # both boundaries; the parent compares against a single-process run of
+    # the same global problem with the same decomposition.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        stepc = diffusion3d.make_multi_step(params, 4, donate=False, fused_k=2)
+        state = jax.block_until_ready(stepc(*state))
+    Tf = igg.gather(diffusion3d.temperature(state), root=0)
+    stats = gather_mod.last_gather_stats
+    assert stats["path"] == "chunked", stats
+    assert stats["blocks"] == nprocs, stats
+    if jax.process_index() == 0:
+        np.save(out_path, Tf)
+    else:
+        assert stats["host_bytes"] == 0, stats
+
+    # (3) Fill-in-place gather rounds across the 2x2 block grid (the gloo
+    # cross-match tripwire, here with FOUR processes contending).
+    for round_ in range(2):
+        buf = np.zeros_like(Tf) if jax.process_index() == 0 else None
+        assert igg.gather(diffusion3d.temperature(state), buf, root=0) is None
+        if jax.process_index() == 0:
+            assert np.array_equal(buf, Tf), (
+                f"fill-in-place gather round {round_} mixed blocks on the "
+                f"{nproc}-process mesh"
+            )
+
+    igg.finalize_global_grid()
+    assert not igg.grid_is_initialized()
+    assert not dist.is_distributed_initialized()
+    print(f"WORKER {pid} OK", flush=True)
+    sys.exit(0)
 
 state, params = diffusion3d.setup(NX, NX, NX, init_grid=False)
 step = diffusion3d.make_step(params)

@@ -1,0 +1,32 @@
+"""Tier-1 collective-budget lint (`scripts/check_collectives.py`, ISSUE 5).
+
+Each model's production exchange set must stay within <= 2 collective-
+permutes per exchanged (dimension, dtype width group) on the virtual mesh —
+the structural guarantee of the coalesced exchange.  A regression back to
+per-field collectives (or extra hops) fails the suite, like an undocumented
+knob fails the knob lint.
+"""
+
+import importlib.util
+import os
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_spec = importlib.util.spec_from_file_location(
+    "igg_check_collectives",
+    os.path.join(os.path.dirname(_here), "scripts", "check_collectives.py"),
+)
+check_collectives = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_collectives)
+
+
+def test_models_within_collective_budget():
+    probs = check_collectives.violations()
+    assert not probs, "collective budget violations:\n" + "\n".join(
+        f"  - {p}" for p in probs
+    )
+
+
+def test_budget_table_covers_all_models():
+    assert set(check_collectives.BUDGET_PAIRS) == {
+        "diffusion", "acoustic", "porous"
+    }

@@ -454,22 +454,123 @@ def test_indivisible_shape_error():
 # ---------------------------------------------------------------- HLO checks
 
 
+# Per-path collective BUDGET (ISSUE 5): pinned counts AND per-hop payload
+# bytes for the serialized per-field, coalesced, padded-face and pipelined
+# (begin/finish) exchange variants, via `hlo_analysis.collective_payloads`.
+
+
+def _collective_records(hlo):
+    from implicitglobalgrid_tpu.utils.hlo_analysis import collective_payloads
+
+    n = hlo.count(" collective-permute(") + hlo.count(" collective-permute-start(")
+    recs = collective_payloads(hlo)
+    assert len(recs) == n  # every hop carries a parseable payload
+    return recs
+
+
+def _compiled_stencil_hlo(body, args):
+    from jax.sharding import PartitionSpec as P
+
+    from implicitglobalgrid_tpu.utils.compat import shard_map
+
+    gg = igg.get_global_grid()
+    specs = tuple(P(*igg.AXIS_NAMES[: a.ndim]) for a in args)
+    mapped = shard_map(
+        body, mesh=gg.mesh, in_specs=specs, out_specs=specs, check_vma=False
+    )
+    return jax.jit(mapped).lower(*args).compile().as_text()
+
+
 def test_collective_permute_count():
-    # 2 ppermutes per exchanged dim per field; none for self/absent neighbors
+    """Serialized path budget: 2 ppermutes per exchanged dim per FIELD with
+    per-field collectives; 2 per exchanged (dim, dtype width group) with
+    the coalesced default — same total payload bytes, pinned per hop."""
     igg.init_global_grid(6, 6, 6, periodz=1, quiet=True)
     gg = igg.get_global_grid()
     from implicitglobalgrid_tpu.ops import halo as H
 
-    exchanged_dims = sum(1 for d in range(3) if gg.dims[d] > 1 or gg.periods[d])
+    exchanged = sum(1 for d in range(3) if gg.dims[d] > 1 or gg.periods[d])
     nfields = 2
     sig = tuple((((6, 6, 6)), "float64") for _ in range(nfields))
-    fn = H._global_update_fn(gg, sig)
     f = unique_field((6, 6, 6), gg)
     g = unique_field((6, 6, 6), gg) * 2
-    hlo = fn.lower(put(f), put(g)).compile().as_text()
-    n_cp = hlo.count(" collective-permute(")
-    n_cp_start = hlo.count(" collective-permute-start(")
-    assert n_cp + n_cp_start == 2 * exchanged_dims * nfields
+    plane_bytes = 6 * 6 * 8  # width-1 f64 slab of the 6^3 local block
+
+    recs = _collective_records(
+        H._global_update_fn(gg, sig, 1, False, False)
+        .lower(put(f), put(g)).compile().as_text()
+    )
+    assert len(recs) == 2 * exchanged * nfields
+    assert {r["bytes"] for r in recs} == {plane_bytes}
+
+    recs_c = _collective_records(
+        H._global_update_fn(gg, sig, 1, False, True)
+        .lower(put(f), put(g)).compile().as_text()
+    )
+    # one width group (both f64): one permute pair per dim, double payload
+    assert len(recs_c) == 2 * exchanged
+    assert {r["bytes"] for r in recs_c} == {nfields * plane_bytes}
+    assert sum(r["bytes"] for r in recs_c) == sum(r["bytes"] for r in recs)
+    igg.finalize_global_grid()
+
+
+def test_collective_budget_padded_faces():
+    """Padded-face staggered path budget: the 4-field `pad_faces`-layout
+    exchange rides 2 collectives per field per dim with per-field
+    collectives and ONE f32-group pair per dim coalesced — with the same
+    total slab payload either way (the pack is a relayout, not a resend)."""
+    from implicitglobalgrid_tpu.ops.halo import update_halo_padded_faces
+    from implicitglobalgrid_tpu.ops.pallas_leapfrog import pad_faces
+
+    igg.init_global_grid(8, 8, 8, overlapx=4, overlapy=4, overlapz=4,
+                         periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    exchanged = sum(1 for d in range(3) if gg.dims[d] > 1 or gg.periods[d])
+
+    args = [put(unique_field((8, 8, 8), gg).astype(np.float32))]
+    for ax in range(3):
+        shp = tuple(8 + (1 if d == ax else 0) for d in range(3))
+        args.append(put(unique_field(shp, gg).astype(np.float32)))
+
+    totals = {}
+    for coalesce, n_per_dim in ((False, 8), (True, 2)):
+        def body(C, Ax, Ay, Az, _co=coalesce):
+            return update_halo_padded_faces(
+                C, *pad_faces(Ax, Ay, Az), width=2, coalesce=_co
+            )
+
+        recs = _collective_records(_compiled_stencil_hlo(body, args))
+        assert len(recs) == n_per_dim * exchanged, (coalesce, len(recs))
+        totals[coalesce] = sum(r["bytes"] for r in recs)
+    assert totals[True] == totals[False] > 0
+    igg.finalize_global_grid()
+
+
+@pytest.mark.parametrize("coalesce,n_per_dim", [(False, 4), (True, 2)])
+def test_collective_budget_pipelined_begin_finish(coalesce, n_per_dim):
+    """Pipelined early-dispatch path budget: `begin_slab_exchange` over two
+    fields emits ``n_per_dim`` collectives per exchanged dim in the
+    compiled program, with unchanged per-hop slab payloads."""
+    from implicitglobalgrid_tpu.ops import halo as H
+
+    igg.init_global_grid(6, 6, 6, periodz=1, quiet=True)
+    gg = igg.get_global_grid()
+    exchanged = sum(1 for d in range(3) if gg.dims[d] > 1 or gg.periods[d])
+
+    def body(a, b):
+        pend = H.begin_slab_exchange((a, b), (0, 1, 2), width=1,
+                                     coalesce=coalesce)
+        return H.finish_slab_exchange((a, b), pend)
+
+    f = unique_field((6, 6, 6), gg)
+    recs = _collective_records(
+        _compiled_stencil_hlo(body, (put(f), put(f * 2)))
+    )
+    assert len(recs) == n_per_dim * exchanged
+    plane_bytes = 6 * 6 * 8
+    expect = plane_bytes * (2 if coalesce else 1)
+    assert {r["bytes"] for r in recs} == {expect}
+    igg.finalize_global_grid()
 
 
 @pytest.mark.parametrize("seed", range(8))
