@@ -37,6 +37,13 @@ profiler annotations on the pipelined ring/interior passes and the slab
 exchange, and `telemetry_snapshot` / `dump_metrics` (JSON + Prometheus
 text) as the public surface.  ``IGG_TELEMETRY=0`` disables it all on a
 zero-allocation branch.
+
+Static analysis (docs/static-analysis.md): ``igg.analysis`` — a pass
+registry running over the package AST, traced jaxprs of the public entry
+points, and optimized HLO; ships a cross-rank collective-consistency
+(deadlock) detector, a trace-time knob-binding lint, a Pallas aliasing
+lint, and the suite-wide overlap-independence check
+(``scripts/igg_lint.py`` is the CLI; the full suite runs in tier-1).
 """
 
 from .parallel.grid import (
@@ -84,6 +91,7 @@ from .utils.checkpoint import (
 )
 from .utils import telemetry
 from .utils.telemetry import dump_metrics, telemetry_snapshot
+from . import analysis
 
 __version__ = "0.1.0"
 
@@ -142,4 +150,6 @@ __all__ = [
     "telemetry",
     "telemetry_snapshot",
     "dump_metrics",
+    # static-analysis subsystem (docs/static-analysis.md)
+    "analysis",
 ]

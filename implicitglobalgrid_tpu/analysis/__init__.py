@@ -1,0 +1,55 @@
+"""``igg.analysis`` — pluggable static-analysis suite (docs/static-analysis.md).
+
+Four shipped analyzers run over three IRs the codebase already produces
+(package AST, traced jaxprs of the public entry points under the production
+config matrix, optimized HLO via `utils.hlo_analysis`):
+
+* ``collective-consistency`` — cross-rank collective-ordering divergence
+  (the distributed-deadlock class found by hand in PR 1), as AST
+  rank-guard detection + traced perm/``cond`` checks + the
+  per-simulated-rank host-plan census (`ops.gather.collective_plan`);
+* ``knob-binding`` — ``IGG_*``/``os.environ`` reads reachable from
+  jit/shard_map/Pallas-traced code (values silently baked into stale jit
+  caches);
+* ``pallas-aliasing`` — ``input_output_aliases``/donation declarations vs
+  the actual in-place contract;
+* ``overlap-independence`` — the pipelined schedules' structural
+  kernel/exchange independence, enforced across all models;
+
+plus the two pre-existing lints as registry passes: ``collective-budget``
+and ``knob-decl`` (their scripts are now thin wrappers).
+
+Entry points: `run` (in-process), ``scripts/igg_lint.py`` (CLI),
+``tests/test_lint_suite.py`` (tier-1).  This module imports no jax — the
+traced IRs build lazily inside a run.
+"""
+
+from .core import (
+    DEFAULT_BASELINE,
+    FAILING,
+    SEVERITIES,
+    AnalyzerSpec,
+    Baseline,
+    Context,
+    Finding,
+    Report,
+    available_analyzers,
+    changed_files,
+    run,
+    select_for_paths,
+)
+
+__all__ = [
+    "AnalyzerSpec",
+    "Baseline",
+    "Context",
+    "DEFAULT_BASELINE",
+    "FAILING",
+    "Finding",
+    "Report",
+    "SEVERITIES",
+    "available_analyzers",
+    "changed_files",
+    "run",
+    "select_for_paths",
+]

@@ -1,0 +1,293 @@
+"""Collective-budget analyzer (the `scripts/check_collectives.py` core).
+
+The coalesced exchange's value is structural — one collective-permute pair
+per (dimension, dtype width group) regardless of field count — and it is
+provable below the compiler: trace each model's production exchange set on
+the virtual 8-device mesh and count the ppermute equations per exchanged
+dimension.  The budget table pins the allowed pairs; a regression that
+silently re-serializes the exchange into per-field collectives (or emits
+extras) fails the suite.  The per-field control (coalesce=False must
+EXCEED the budget) keeps the census itself honest.
+
+`scripts/check_collectives.py` is the thin CLI wrapper; the tier-1 test
+`tests/test_collective_budget.py` keeps its exit-code contract.
+"""
+
+from __future__ import annotations
+
+from .core import Context, Finding
+from .ir import model_field_structs
+
+ANALYZER = "collective-budget"
+
+#: Allowed collective-permute PAIRS per exchanged dimension for each model's
+#: production exchange set (all fields f32 => ONE dtype width group each).
+#: The per-field counts these budgets forbid are len(fields) pairs per dim.
+BUDGET_PAIRS = {
+    "diffusion": 1,  # T
+    "acoustic": 1,   # P, Vx, Vy, Vz — 4 fields, one pair
+    "porous": 1,     # Pf, qDx, qDy, qDz, T — the 5-field step, one pair
+}
+
+
+def _count_ppermutes(jaxpr) -> int:
+    n = 0
+    for e in jaxpr.eqns:
+        if e.primitive.name == "ppermute":
+            n += 1
+        for v in e.params.values():
+            if hasattr(v, "jaxpr"):
+                n += _count_ppermutes(v.jaxpr)
+            elif hasattr(v, "eqns"):
+                n += _count_ppermutes(v)
+    return n
+
+
+def _traced_dim_ppermutes(fields, d: int, coalesce) -> int:
+    """ppermute equations in the traced dim-``d`` exchange of ``fields``
+    (the shard_map/spec scaffolding is `ir._trace_mapped`'s — one tracing
+    convention for every analyzer, so the censuses cannot drift)."""
+    import implicitglobalgrid_tpu as igg
+    from ..ops.halo import exchange_dims_multi
+    from .ir import _trace_mapped
+
+    def body(*fs):
+        return exchange_dims_multi(fs, (d,), width=1, coalesce=coalesce)
+
+    gg = igg.get_global_grid()
+    return _count_ppermutes(_trace_mapped(body, fields, gg).jaxpr)
+
+
+def budget_findings(n: int = 8, budget_pairs=None) -> list[Finding]:
+    """Findings of one budget run (empty = clean).
+
+    Grid: dims (2,2,2), periodic z — every dimension exchanges, both
+    PROC_NULL and periodic transports in one config.  Explicit
+    ``coalesce=True`` pins the budget to the coalesced path regardless of
+    ``IGG_COALESCE`` (the knob toggles per-field attribution; the budget's
+    claim is about what the DEFAULT production path emits).
+    """
+    import implicitglobalgrid_tpu as igg
+
+    budget_pairs = BUDGET_PAIRS if budget_pairs is None else budget_pairs
+    out = []
+    igg.init_global_grid(n, n, n, dimx=2, dimy=2, dimz=2, periodz=1,
+                         quiet=True)
+    try:
+        for model, pairs in budget_pairs.items():
+            fields = model_field_structs(model, n)
+            for d in range(3):
+                got = _traced_dim_ppermutes(fields, d, coalesce=True)
+                if got > 2 * pairs:
+                    out.append(
+                        Finding(
+                            analyzer=ANALYZER,
+                            code="budget-exceeded",
+                            severity="ERROR",
+                            message=(
+                                f"{model}: dimension {d} emits {got} "
+                                f"collective-permutes for {len(fields)} "
+                                f"fields — budget is {2 * pairs} "
+                                f"({pairs} pair(s); the coalesced exchange "
+                                f"regressed to per-field collectives?)"
+                            ),
+                            symbol=f"{model}/dim{d}",
+                            anchor=str(got),
+                        )
+                    )
+            # The lint itself must be alive: the per-field control has to
+            # exceed the budget for every multi-field model, or the counter
+            # is not seeing the collectives at all.
+            if len(fields) > 1:
+                ctrl = _traced_dim_ppermutes(fields, 0, coalesce=False)
+                if ctrl != 2 * len(fields):
+                    out.append(
+                        Finding(
+                            analyzer=ANALYZER,
+                            code="census-broken",
+                            severity="ERROR",
+                            message=(
+                                f"{model}: per-field control counted "
+                                f"{ctrl} collectives in dim 0, expected "
+                                f"{2 * len(fields)} — the ppermute census "
+                                f"is broken"
+                            ),
+                            symbol=f"{model}/control",
+                            anchor=str(ctrl),
+                        )
+                    )
+    finally:
+        igg.finalize_global_grid()
+    return out
+
+
+def violation_strings(n: int = 8, budget_pairs=None) -> list[str]:
+    """The `scripts/check_collectives.py` contract: human-readable
+    violations, empty list = clean."""
+    return [f.message for f in budget_findings(n, budget_pairs)]
+
+
+def entry_budget_findings(entries, budget_pairs=None) -> list[Finding]:
+    """The budget census over the SHARED traced-entry matrix.
+
+    The suite path: `run(ctx)` counts ppermutes per exchanged dimension in
+    the `Context.exchange_entries()` programs the consistency pass already
+    traced (each ppermute's mesh-axis name identifies its dimension; the
+    ``coalesce=False`` twin is the per-field liveness control), so the
+    full suite traces the exchange matrix exactly once.  `budget_findings`
+    keeps its self-managed grid for the standalone
+    ``scripts/check_collectives.py`` entry.
+    """
+    from .. import AXIS_NAMES
+    from .ir import model_field_structs
+
+    budget_pairs = BUDGET_PAIRS if budget_pairs is None else budget_pairs
+    by_name = {e.name: e for e in entries}
+    out = []
+    for model, pairs in budget_pairs.items():
+        coal = by_name.get(f"exchange/{model}[coalesce=True]")
+        ctrl = by_name.get(f"exchange/{model}[coalesce=False]")
+        if coal is None or ctrl is None:
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="census-broken",
+                    severity="ERROR",
+                    message=(
+                        f"{model}: the traced entry matrix is missing the "
+                        f"coalesce=True/False exchange entries — the "
+                        f"budget census has nothing to count."
+                    ),
+                    symbol=f"{model}/entries",
+                    anchor="missing",
+                )
+            )
+            continue
+        nfields = len(model_field_structs(model, 8))
+        counts = {a: 0 for a in AXIS_NAMES}
+        for op in coal.collectives():
+            if op.kind == "ppermute" and op.axes:
+                counts[op.axes[0]] = counts.get(op.axes[0], 0) + 1
+        for d, axis in enumerate(AXIS_NAMES):
+            got = counts.get(axis, 0)
+            if got > 2 * pairs:
+                out.append(
+                    Finding(
+                        analyzer=ANALYZER,
+                        code="budget-exceeded",
+                        severity="ERROR",
+                        message=(
+                            f"{model}: dimension {d} emits {got} "
+                            f"collective-permutes for {nfields} fields — "
+                            f"budget is {2 * pairs} ({pairs} pair(s); the "
+                            f"coalesced exchange regressed to per-field "
+                            f"collectives?)"
+                        ),
+                        symbol=f"{model}/dim{d}",
+                        anchor=str(got),
+                    )
+                )
+        if nfields > 1:
+            c0 = sum(
+                1
+                for op in ctrl.collectives()
+                if op.kind == "ppermute" and op.axes
+                and op.axes[0] == AXIS_NAMES[0]
+            )
+            if c0 != 2 * nfields:
+                out.append(
+                    Finding(
+                        analyzer=ANALYZER,
+                        code="census-broken",
+                        severity="ERROR",
+                        message=(
+                            f"{model}: per-field control counted {c0} "
+                            f"collectives in dim 0, expected "
+                            f"{2 * nfields} — the ppermute census is "
+                            f"broken"
+                        ),
+                        symbol=f"{model}/control",
+                        anchor=str(c0),
+                    )
+                )
+    return out
+
+
+def hlo_budget_findings(txt: str, *, model: str = "porous",
+                        pairs: int | None = None,
+                        active_dims: int = 3) -> list[Finding]:
+    """The budget's optimized-HLO cross-check (pure over the HLO text).
+
+    The jaxpr census proves what the PROGRAM asks for; this proves what the
+    COMPILER kept: after XLA optimization the coalesced exchange must still
+    be within ``2 * pairs`` collective-permutes per exchanged dimension
+    (splitting a packed hop back apart would silently re-serialize the
+    fabric traffic), and every permute's payload must parse cleanly through
+    `utils.hlo_analysis.collective_payloads` with no raw-sum fallback —
+    unaccounted payload bytes make every downstream budget an estimate.
+    """
+    from ..utils.hlo_analysis import collective_payloads
+
+    pairs = BUDGET_PAIRS[model] if pairs is None else pairs
+    n_perm = txt.count(" collective-permute(") + txt.count(
+        " collective-permute-start("
+    )
+    recs = collective_payloads(txt)
+    out = []
+    if n_perm == 0 or len(recs) != n_perm:
+        out.append(
+            Finding(
+                analyzer=ANALYZER,
+                code="hlo-census-broken",
+                severity="ERROR",
+                message=(
+                    f"{model}: optimized HLO shows {n_perm} "
+                    f"collective-permute(s) but collective_payloads "
+                    f"accounts for {len(recs)} — the HLO payload census "
+                    f"lost track of the exchange."
+                ),
+                symbol=f"{model}/hlo",
+                anchor="census",
+            )
+        )
+    budget = 2 * pairs * active_dims
+    if n_perm > budget:
+        out.append(
+            Finding(
+                analyzer=ANALYZER,
+                code="hlo-budget-exceeded",
+                severity="ERROR",
+                message=(
+                    f"{model}: the OPTIMIZED program emits {n_perm} "
+                    f"collective-permutes across {active_dims} exchanged "
+                    f"dimension(s) — budget is {budget} ({pairs} pair(s) "
+                    f"per dim); the compiler split the coalesced hops "
+                    f"back apart."
+                ),
+                symbol=f"{model}/hlo",
+                anchor=str(n_perm),
+            )
+        )
+    for i, rec in enumerate(recs):
+        if rec.get("payload_fallback"):
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="hlo-payload-fallback",
+                    severity="WARNING",
+                    message=(
+                        f"{model}: collective-permute {i} payload fell "
+                        f"back to a raw operand sum ({rec['shape']}) — "
+                        f"its byte count is an upper bound, not exact."
+                    ),
+                    symbol=f"{model}/hlo",
+                    anchor=f"hop{i}",
+                )
+            )
+    return out
+
+
+def run(ctx: Context) -> list[Finding]:
+    return entry_budget_findings(ctx.exchange_entries()) + hlo_budget_findings(
+        ctx.exchange_hlo()
+    )

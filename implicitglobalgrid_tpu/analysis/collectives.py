@@ -1,0 +1,426 @@
+"""Cross-rank collective-consistency / SPMD-divergence detector.
+
+``update_halo!`` in the reference (and every collective here) is only safe
+because all ranks issue the same collectives in the same order; one rank
+diverging — the PR-1 ``_gather_chunked`` hang, where non-root processes ran
+a different in-flight collective schedule than the root — deadlocks the
+fabric.  MPI tools like MUST detect this at runtime; GSPMD's partitioner
+proves it per-program at compile time.  This analyzer makes it a trace-time
+invariant over three evidence sources:
+
+1. **AST rank-guard pass** — any collective call lexically nested under
+   ``if``/``while``/ternary control flow whose predicate mentions a
+   rank-identity (``rank``, ``coords``, ``process_index``, ``is_root``...)
+   is exactly the hang class and is flagged CRITICAL.
+2. **Traced-jaxpr census** — every entry point of the config matrix is
+   traced; each collective's ``perm`` must be a valid partial permutation
+   (duplicate sources/targets = data races, out-of-range = silent drops)
+   and no collective may sit inside a ``cond`` branch (a rank-divergent
+   predicate would run it on a subset of ranks).  A traced SPMD program
+   is ONE program — rank-uniformity of its dispatch sequence holds by
+   construction, which is exactly why the remaining divergence channels
+   are Python-level (caught by the AST pass: each real process traces
+   its OWN program, so a rank-guarded trace-time branch yields different
+   programs per process) and host-level (caught below).
+3. **Host-plan census** — host-side orchestration loops issue compiled
+   collectives per dispatch where no jaxpr sees the ORDER.  Such entry
+   points expose a pure ``collective_plan`` (today: `ops.gather`), which
+   is evaluated per simulated rank (root and every non-root) and must be
+   identical — the PR-1 flaky gather, now a static invariant next to its
+   3-round runtime tripwire.  Additional censuses register via
+   `register_census_provider` (how the seeded-divergence fixtures drive
+   the real pipeline in `tests/test_static_analysis.py`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding
+from .ir import RankCensus
+
+ANALYZER = "collective-consistency"
+
+#: Call names that issue (or wrap) a cross-rank collective.  The package's
+#: own transport helpers are included so a guard ABOVE the lax call is
+#: still caught at the call site that matters.
+COLLECTIVE_CALL_NAMES = frozenset(
+    {
+        "ppermute",
+        "psum",
+        "pmax",
+        "pmin",
+        "pmean",
+        "all_gather",
+        "all_to_all",
+        "pbroadcast",
+        "collective_permute",
+        "_permute_slabs",
+        "_coalesced_permute",
+    }
+)
+
+#: Identifier fragments that name a rank identity.  A predicate mentioning
+#: one of these differs across ranks by construction.
+RANKISH_NAMES = frozenset(
+    {
+        "rank",
+        "myrank",
+        "my_rank",
+        "coords",
+        "is_root",
+        "process_index",
+        "proc_id",
+        "procid",
+        "me",
+    }
+)
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _rankish_terms(test: ast.AST) -> list[str]:
+    """Rank-identity terms mentioned in a predicate expression."""
+    hits = []
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id.lower() in RANKISH_NAMES:
+            hits.append(n.id)
+        elif isinstance(n, ast.Attribute) and n.attr.lower() in RANKISH_NAMES:
+            hits.append(n.attr)
+        elif isinstance(n, ast.Call) and _call_name(n) in (
+            "process_index",
+            "axis_index",
+        ):
+            hits.append(_call_name(n))
+    return hits
+
+
+def _always_exits(body: list) -> bool:
+    """The statement list unconditionally leaves the enclosing block —
+    ends in return/raise/continue/break (the early-exit guard idiom)."""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class _RankGuardVisitor(ast.NodeVisitor):
+    """Find collective calls under rank-dependent Python control flow.
+
+    Both guard shapes are covered: a collective lexically INSIDE a
+    rank-conditioned branch, and the early-exit form — ``if rank != 0:
+    return x`` followed by the collective — where every statement after
+    the exiting branch runs only for the ranks that did not take it (the
+    commonest shape of the PR-1 divergence).
+    """
+
+    def __init__(self, rel_path: str):
+        self.rel = rel_path
+        self.guards: list[tuple[ast.AST, list[str]]] = []
+        self.func_stack: list[str] = []
+        self.findings: list[Finding] = []
+
+    def _with_guard(self, test, bodies):
+        terms = _rankish_terms(test)
+        if terms:
+            self.guards.append((test, terms))
+        for b in bodies:
+            if isinstance(b, list):
+                self._visit_block(b)
+            else:
+                self.visit(b)
+        if terms:
+            self.guards.pop()
+
+    def _visit_block(self, stmts: list):
+        """Visit a statement list; a rank-conditioned ``if`` whose taken
+        branch always exits guards the REST of the block too."""
+        pushed = 0
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                self.visit(stmt.test)
+                self._with_guard(stmt.test, [stmt.body, stmt.orelse])
+                terms = _rankish_terms(stmt.test)
+                if terms and (
+                    _always_exits(stmt.body) or _always_exits(stmt.orelse)
+                ):
+                    self.guards.append((stmt.test, terms))
+                    pushed += 1
+            else:
+                self.visit(stmt)
+        for _ in range(pushed):
+            self.guards.pop()
+
+    def visit_If(self, node: ast.If):
+        # fallback for If nodes reached outside a _visit_block context
+        self.visit(node.test)
+        self._with_guard(node.test, [node.body, node.orelse])
+
+    def visit_While(self, node: ast.While):
+        self.visit(node.test)
+        self._with_guard(node.test, [node.body, node.orelse])
+
+    def visit_For(self, node: ast.For):
+        self.visit(node.target)
+        self.visit(node.iter)
+        self._visit_block(node.body)
+        self._visit_block(node.orelse)
+
+    def visit_With(self, node: ast.With):
+        for item in node.items:
+            self.visit(item)
+        self._visit_block(node.body)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self.visit(node.test)
+        self._with_guard(node.test, [node.body, node.orelse])
+
+    def _visit_func(self, node):
+        self.func_stack.append(node.name)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self._visit_block(node.body)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call):
+        name = _call_name(node)
+        if name in COLLECTIVE_CALL_NAMES and self.guards:
+            terms = sorted({t for _, ts in self.guards for t in ts})
+            qual = ".".join(self.func_stack) or "<module>"
+            self.findings.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="rank-guarded-collective",
+                    severity="CRITICAL",
+                    message=(
+                        f"collective `{name}` is issued under Python "
+                        f"control flow conditioned on rank identity "
+                        f"({', '.join(terms)}) — ranks taking different "
+                        f"branches issue different collective sequences "
+                        f"and deadlock (the PR-1 _gather_chunked class)."
+                    ),
+                    path=self.rel,
+                    line=node.lineno,
+                    symbol=qual,
+                    anchor=name,
+                    fix_hint=(
+                        "issue the collective unconditionally on every "
+                        "rank and mask its RESULT per rank (jnp.where / "
+                        "contribute zeros), or lift the branch above the "
+                        "collective so all ranks agree on it."
+                    ),
+                )
+            )
+        self.generic_visit(node)
+
+
+def ast_findings(ctx: Context) -> list[Finding]:
+    out = []
+    for rel, (_src, tree) in ctx.module_asts().items():
+        v = _RankGuardVisitor(rel)
+        v.visit(tree)
+        out.extend(v.findings)
+    return out
+
+
+# -- traced-jaxpr census ------------------------------------------------------
+
+
+def check_rank_consistency(census: RankCensus) -> list[Finding]:
+    """The core invariant: every rank's ordered collective sequence is
+    identical.  Shared by the host-plan censuses and the seeded test
+    fixtures."""
+    items = sorted(census.sequences.items(), key=lambda kv: str(kv[0]))
+    if not items:
+        return []
+    ref_rank, ref = items[0]
+    out = []
+    for rank, seq in items[1:]:
+        if seq == ref:
+            continue
+        # first divergence position, for a actionable message
+        i = next(
+            (
+                j
+                for j in range(min(len(ref), len(seq)))
+                if ref[j] != seq[j]
+            ),
+            min(len(ref), len(seq)),
+        )
+        at = (
+            f"op {i}: rank {ref_rank} issues {ref[i]!r}, rank {rank} "
+            f"issues {seq[i]!r}"
+            if i < min(len(ref), len(seq))
+            else f"rank {ref_rank} issues {len(ref)} collective(s), rank "
+            f"{rank} issues {len(seq)}"
+        )
+        out.append(
+            Finding(
+                analyzer=ANALYZER,
+                code="rank-divergent-sequence",
+                severity="CRITICAL",
+                message=(
+                    f"entry `{census.name}`: collective sequences diverge "
+                    f"across ranks — {at}.  A rank waiting in a collective "
+                    f"its peers never issue hangs the fabric."
+                ),
+                symbol=census.name,
+                anchor=str(rank),
+                fix_hint=(
+                    "make every rank issue the identical dispatch "
+                    "sequence; rank-dependent work must happen host-side "
+                    "on the fetched results, never in the collective "
+                    "schedule."
+                ),
+            )
+        )
+        break  # one finding per entry; the first divergent rank names it
+    return out
+
+
+def _perm_findings(entry) -> list[Finding]:
+    out = []
+    for i, op in enumerate(entry.collectives()):
+        if "cond" in op.path:
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="collective-under-cond",
+                    severity="CRITICAL",
+                    message=(
+                        f"entry `{entry.name}`: `{op.kind}` (op {i}) is "
+                        f"traced inside a `cond` branch "
+                        f"(path {'/'.join(op.path)}) — if the predicate "
+                        f"ever differs across ranks, only some ranks run "
+                        f"the collective."
+                    ),
+                    symbol=entry.name,
+                    anchor=f"op{i}-cond",
+                    fix_hint=(
+                        "hoist the collective out of the cond, or prove "
+                        "the predicate is replicated and select on the "
+                        "result instead."
+                    ),
+                )
+            )
+        if op.kind != "ppermute" or op.perm is None:
+            continue
+        axis_size = entry.mesh_shape.get(op.axes[0]) if op.axes else None
+        srcs = [s for s, _ in op.perm]
+        dsts = [d for _, d in op.perm]
+        bad = []
+        if len(set(srcs)) != len(srcs):
+            bad.append("duplicate sources (a rank sends twice in one hop)")
+        if len(set(dsts)) != len(dsts):
+            bad.append(
+                "duplicate targets (two ranks write one rank's buffer)"
+            )
+        if axis_size is not None and any(
+            not (0 <= x < axis_size) for x in srcs + dsts
+        ):
+            bad.append(f"index outside the axis size {axis_size}")
+        if bad:
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="malformed-permute",
+                    severity="CRITICAL",
+                    message=(
+                        f"entry `{entry.name}`: ppermute op {i} has an "
+                        f"invalid perm {op.perm}: {'; '.join(bad)}."
+                    ),
+                    symbol=entry.name,
+                    anchor=f"op{i}-perm",
+                )
+            )
+    return out
+
+
+def traced_findings(ctx: Context) -> list[Finding]:
+    # Perm validity + no-collective-under-cond per traced entry.  No
+    # per-rank equality check here: one traced jaxpr IS one program, so
+    # its dispatch sequence is rank-uniform by construction — the
+    # divergence channels that can actually differ per rank are Python
+    # control flow (ast_findings) and host-side plans (host_plan_findings).
+    out = []
+    for entry in list(ctx.exchange_entries()) + list(ctx.cadence_entries()):
+        out.extend(_perm_findings(entry))
+    return out
+
+
+# -- host-plan census ---------------------------------------------------------
+
+#: Census providers: callables ``ctx -> iterable[RankCensus]``.  Extensible
+#: so host-side orchestration added later (and test fixtures) plug into the
+#: same detector.
+CENSUS_PROVIDERS: list = []
+
+
+def register_census_provider(fn):
+    """Register a ``ctx -> iterable[RankCensus]`` provider.  Returns ``fn``
+    (decorator-friendly); remove with ``CENSUS_PROVIDERS.remove(fn)``."""
+    CENSUS_PROVIDERS.append(fn)
+    return fn
+
+
+#: (dims, batch, root) grids the gather plan is simulated over — small,
+#: ragged-tail-covering, and with a non-default root.
+_GATHER_PLAN_CONFIGS = (
+    ((2, 2, 2), 3, 0),
+    ((2, 2, 2), 8, 7),
+    ((4, 2, 1), 3, 5),
+    ((1,), 1, 0),
+)
+
+
+def gather_plan_censuses(ctx: Context):
+    """The `_gather_chunked` collective schedule per simulated rank.
+
+    `ops.gather.collective_plan` is the single source of the chunked
+    gather's dispatch order; its ``is_root`` parameter exists precisely so
+    this census can prove the schedule ignores it (the PR-1 hang was
+    non-roots running a DIFFERENT in-flight schedule than the root).
+    """
+    from ..ops.gather import collective_plan
+
+    for dims, batch, root in _GATHER_PLAN_CONFIGS:
+        nprocs = 1
+        for d in dims:
+            nprocs *= d
+        yield RankCensus(
+            name=f"host/gather_chunked[dims={dims},batch={batch},"
+            f"root={root}]",
+            sequences={
+                rank: tuple(
+                    ("block_fetch",) + tuple(rec[1:])
+                    for rec in collective_plan(
+                        dims, batch, is_root=(rank == root)
+                    )
+                )
+                for rank in range(nprocs)
+            },
+        )
+
+
+register_census_provider(gather_plan_censuses)
+
+
+def host_plan_findings(ctx: Context) -> list[Finding]:
+    out = []
+    for provider in list(CENSUS_PROVIDERS):
+        for census in provider(ctx):
+            out.extend(check_rank_consistency(census))
+    return out
+
+
+def run(ctx: Context) -> list[Finding]:
+    return ast_findings(ctx) + host_plan_findings(ctx) + traced_findings(ctx)

@@ -1,0 +1,103 @@
+"""Overlap-independence analyzer (ISSUE 2's structural guarantee, suite-wide).
+
+The pipelined group schedule's whole value is a dataflow shape: each
+group's boundary exchange (`collective-permute`s) and its interior kernel
+launch must be mutually independent in the traced program, so the compiler
+is licensed to run them concurrently.  `tests/test_pipelined_schedule.py`
+proved that for ONE diffusion config; this analyzer runs the same
+independence-pair census (`ir.independence_pairs`) over every model's
+cadence, pipelined on and off, so the guarantee is enforced everywhere a
+cadence exists — including models added later.
+
+Invariants:
+
+* serialized cadence: ZERO free (kernel, ppermute) pairs.  This is the
+  census' liveness control (like the per-field control in the collective
+  budget): the serialized schedule orders every launch against every
+  exchange by construction, so free pairs there mean the counter stopped
+  seeing dependencies — a broken analyzer, not a fast schedule.
+* admissible pipelined cadence: at least one free pair per in-flight
+  exchange group (we require ``pairs >= n_kernels / 2`` — ring+interior
+  per group, each group's interior free against its own permutes).
+* a pipelined config that traced as inadmissible (serialized fallback,
+  warn-once) is skipped — "no overlap possible" is not "overlap lost".
+"""
+
+from __future__ import annotations
+
+from .core import Context, Finding
+from .ir import independence_pairs
+
+ANALYZER = "overlap-independence"
+
+
+def run(ctx: Context) -> list[Finding]:
+    out = []
+    for entry in ctx.cadence_entries():
+        pairs, nk, np_ = independence_pairs(entry.jaxpr)
+        if nk == 0 or np_ == 0:
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="census-empty",
+                    severity="ERROR",
+                    message=(
+                        f"entry `{entry.name}`: found {nk} kernel "
+                        f"launch(es) and {np_} collective(s) — the cadence "
+                        f"census sees nothing to analyze; the kernel/"
+                        f"collective detection drifted from the models."
+                    ),
+                    symbol=entry.name,
+                    anchor="empty",
+                )
+            )
+            continue
+        pipelined = bool(entry.config.get("pipelined"))
+        if not pipelined:
+            if pairs != 0:
+                out.append(
+                    Finding(
+                        analyzer=ANALYZER,
+                        code="control-broken",
+                        severity="ERROR",
+                        message=(
+                            f"entry `{entry.name}`: the SERIALIZED cadence "
+                            f"shows {pairs} free (kernel, collective) "
+                            f"pair(s) — it must order every launch against "
+                            f"every exchange, so the independence counter "
+                            f"is no longer seeing dependencies."
+                        ),
+                        symbol=entry.name,
+                        anchor="control",
+                    )
+                )
+            continue
+        if not entry.admissible:
+            continue  # fell back to serialized (warn-once path): no claim
+        want = max(1, nk // 2)
+        if pairs < want:
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="overlap-lost",
+                    severity="ERROR",
+                    message=(
+                        f"entry `{entry.name}`: only {pairs} free "
+                        f"(kernel, collective) pair(s) for {nk} kernel "
+                        f"launch(es) / {np_} collective(s) — expected "
+                        f">= {want}.  The pipelined schedule no longer "
+                        f"creates the kernel/exchange independence ISSUE 2 "
+                        f"exists for; the compiler must serialize them."
+                    ),
+                    symbol=entry.name,
+                    anchor="pairs",
+                    fix_hint=(
+                        "the interior pass grew a dependency on the "
+                        "in-flight exchange (or the early-dispatch "
+                        "begin/finish split regressed) — diff the cadence "
+                        "against tests/test_pipelined_schedule.py's "
+                        "independence proof."
+                    ),
+                )
+            )
+    return out

@@ -165,30 +165,68 @@ def _gather_batch_size() -> int:
     return max(int(val), 1) if val is not None else 8
 
 
+def collective_plan(dims, batch, *, is_root: bool = False):
+    """Ordered collective dispatch schedule of one chunked gather.
+
+    Returns ``[("block_fetch", (sel, ...)), ...]`` — one record per compiled
+    fetch dispatch, each carrying the linearized block indices it
+    replicates.  This is the single source of `_gather_chunked`'s loop
+    shape, extracted so the schedule is a *checkable artifact*: the PR-1
+    ~50%-flaky hang was exactly non-root processes running a different
+    in-flight collective schedule than the root, and the fix's invariant —
+    EVERY process issues the identical dispatch sequence — is now asserted
+    statically by ``igg.analysis``'s collective-consistency detector, which
+    evaluates this plan for every simulated rank and requires equality.
+
+    ``is_root`` is deliberately accepted AND ignored: the parameter exists
+    so the detector can prove the schedule cannot depend on it (root-ness
+    may only affect host-side assembly of fetched results, never the
+    collective order).  Do not branch on it here.
+    """
+    del is_root  # the invariant: the plan is rank-independent
+    idxs = list(np.ndindex(*tuple(dims))) or [()]
+    b = min(max(int(batch), 1), len(idxs))
+    plan = []
+    for start in range(0, len(idxs), b):
+        chunk = idxs[start : start + b]
+        plan.append(
+            (
+                "block_fetch",
+                tuple(
+                    int(np.ravel_multi_index(idx, dims)) if idx else 0
+                    for idx in chunk
+                ),
+            )
+        )
+    return plan
+
+
 def _gather_chunked(A, gg, out: np.ndarray | None, dedup: bool = False):
     """Batched block-by-block multi-host assembly (root-only memory bound).
 
     Collective: every process iterates the same batch sequence (the
     reference's non-roots likewise all participate by sending,
-    `/root/reference/src/gather.jl:33-36`).  The root (the one process with
-    ``out is not None``) places each batch's blocks as they arrive; the
-    replicated device copy is dropped before the next fetch.
+    `/root/reference/src/gather.jl:33-36`), as pinned by `collective_plan`.
+    The root (the one process with ``out is not None``) places each batch's
+    blocks as they arrive; the replicated device copy is dropped before the
+    next fetch.
     """
     import jax
 
     ndim = A.ndim
     bshape = _local_shape(A, gg)
     dims = gg.dims[:ndim]
-    idxs = list(np.ndindex(*dims)) or [()]
-    batch = min(_gather_batch_size(), len(idxs))
+    plan = collective_plan(dims, _gather_batch_size(), is_root=out is not None)
+    nblocks = sum(len(sels) for _, sels in plan)
+    batch = len(plan[0][1])
     host_bytes = 0
     nfetch = 0
-    for start in range(0, len(idxs), batch):
-        chunk = idxs[start : start + batch]
-        sels = np.asarray(
-            [np.ravel_multi_index(idx, dims) if idx else 0 for idx in chunk],
-            np.int32,
-        )
+    for _op, sels_t in plan:
+        chunk = [
+            tuple(int(c) for c in np.unravel_index(s, dims)) if ndim else ()
+            for s in sels_t
+        ]
+        sels = np.asarray(sels_t, np.int32)
         # At most two executables total: the full batch size and one ragged
         # tail size (both cached in `_fetch_cache`).
         fetch = _block_fetch_fn(gg, ndim, bshape, A.dtype, nsel=len(chunk))
@@ -229,7 +267,7 @@ def _gather_chunked(A, gg, out: np.ndarray | None, dedup: bool = False):
             "path": "chunked",
             "host_bytes": host_bytes,
             "fetches": nfetch,
-            "blocks": len(idxs),
+            "blocks": nblocks,
             "batch": batch,
             "block_bytes": int(np.prod(bshape)) * np.dtype(A.dtype).itemsize,
         }

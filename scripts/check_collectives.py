@@ -1,23 +1,21 @@
 #!/usr/bin/env python
 """Collective-budget lint: no exchanged dimension may out-spend its hops.
 
-The coalesced exchange's whole value is structural — one collective-permute
-pair per (dimension, dtype width group) regardless of field count — and it
-is provable below the compiler: trace each model's production exchange set
-on the virtual 8-device mesh and count the ppermute equations per exchanged
-dimension.  The budget table pins the allowed pairs; a regression that
-silently re-serializes the exchange into per-field collectives (or emits
-extras) fails the suite, exactly like an undocumented knob fails
-`check_knobs.py`.
-
-Run standalone (exits nonzero listing violations) or via the tier-1 test
-``tests/test_collective_budget.py``.
+Thin CLI wrapper over the ``collective-budget`` analyzer of ``igg.analysis``
+(`implicitglobalgrid_tpu/analysis/budget.py` — the pass-registry home of
+the census since ISSUE 6; run the whole suite with ``scripts/igg_lint.py``).
+The exit-code contract is unchanged: 0 = every model within <= 2
+collective-permutes per exchanged (dimension, dtype width group), nonzero =
+violations listed on stdout.  The tier-1 test
+``tests/test_collective_budget.py`` calls `violations` directly.
 """
 
 from __future__ import annotations
 
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _ensure_devices() -> None:
@@ -36,75 +34,12 @@ def _ensure_devices() -> None:
         pass
 
 
-#: Allowed collective-permute PAIRS per exchanged dimension for each model's
-#: production exchange set (all fields f32 => ONE dtype width group each).
-#: The per-field counts these budgets forbid are len(fields) pairs per dim.
-BUDGET_PAIRS = {
-    "diffusion": 1,  # T
-    "acoustic": 1,   # P, Vx, Vy, Vz — 4 fields, one pair
-    "porous": 1,     # Pf, qDx, qDy, qDz, T — the 5-field step, one pair
-}
-
-
-def _model_fields(model: str, n: int):
-    """The model's exchanged field set as traced shapes (staggered ``n+1``
-    faces like the real states; f32 like the production configs)."""
-    import jax
-    import jax.numpy as jnp
-
-    def s(shape):
-        return jax.ShapeDtypeStruct(shape, jnp.float32)
-
-    cell = (n, n, n)
-    faces = [tuple(n + (1 if d == ax else 0) for d in range(3)) for ax in range(3)]
-    if model == "diffusion":
-        return (s(cell),)
-    if model == "acoustic":
-        return (s(cell), *map(s, faces))
-    if model == "porous":
-        return (s(cell), *map(s, faces), s(cell))
-    raise ValueError(model)
-
-
-def _count_ppermutes(jaxpr) -> int:
-    n = 0
-    for e in jaxpr.eqns:
-        if e.primitive.name == "ppermute":
-            n += 1
-        for v in e.params.values():
-            if hasattr(v, "jaxpr"):
-                n += _count_ppermutes(v.jaxpr)
-            elif hasattr(v, "eqns"):
-                n += _count_ppermutes(v)
-    return n
-
-
-def _traced_dim_ppermutes(fields, d: int, coalesce) -> int:
-    """ppermute equations in the traced dim-``d`` exchange of ``fields``."""
-    import jax
-    from jax.sharding import PartitionSpec as P
-
-    import implicitglobalgrid_tpu as igg
-    from implicitglobalgrid_tpu.ops.halo import exchange_dims_multi
-    from implicitglobalgrid_tpu.utils.compat import shard_map
-
-    gg = igg.get_global_grid()
-
-    def body(*fs):
-        return exchange_dims_multi(fs, (d,), width=1, coalesce=coalesce)
-
-    specs = tuple(P(*igg.AXIS_NAMES[: f.ndim]) for f in fields)
-    mapped = shard_map(
-        body, mesh=gg.mesh, in_specs=specs, out_specs=specs, check_vma=False
-    )
-    # Local-block shapes scale to global for the shard_map entry.
-    gargs = tuple(
-        jax.ShapeDtypeStruct(
-            tuple(s * gg.dims[i] for i, s in enumerate(f.shape)), f.dtype
-        )
-        for f in fields
-    )
-    return _count_ppermutes(jax.make_jaxpr(mapped)(*gargs).jaxpr)
+from implicitglobalgrid_tpu.analysis.budget import (  # noqa: E402
+    BUDGET_PAIRS,
+    violation_strings,
+    _count_ppermutes,  # re-exported: tests/test_coalesced_halo.py counts
+    # with the lint's own census so the two counters cannot drift
+)
 
 
 def violations(n: int = 8) -> list[str]:
@@ -113,37 +48,7 @@ def violations(n: int = 8) -> list[str]:
     Grid: dims (2,2,2), periodic z — every dimension exchanges, both
     PROC_NULL and periodic transports in one config.
     """
-    import implicitglobalgrid_tpu as igg
-
-    out = []
-    igg.init_global_grid(n, n, n, dimx=2, dimy=2, dimz=2, periodz=1,
-                         quiet=True)
-    try:
-        for model, pairs in BUDGET_PAIRS.items():
-            fields = _model_fields(model, n)
-            for d in range(3):
-                got = _traced_dim_ppermutes(fields, d, coalesce=None)
-                if got > 2 * pairs:
-                    out.append(
-                        f"{model}: dimension {d} emits {got} collective-"
-                        f"permutes for {len(fields)} fields — budget is "
-                        f"{2 * pairs} ({pairs} pair(s); the coalesced "
-                        f"exchange regressed to per-field collectives?)"
-                    )
-            # The lint itself must be alive: the per-field control has to
-            # exceed the budget for every multi-field model, or the counter
-            # is not seeing the collectives at all.
-            if len(fields) > 1:
-                ctrl = _traced_dim_ppermutes(fields, 0, coalesce=False)
-                if ctrl != 2 * len(fields):
-                    out.append(
-                        f"{model}: per-field control counted {ctrl} "
-                        f"collectives in dim 0, expected {2 * len(fields)} — "
-                        f"the ppermute census is broken"
-                    )
-    finally:
-        igg.finalize_global_grid()
-    return out
+    return violation_strings(n, BUDGET_PAIRS)
 
 
 def main() -> int:
@@ -162,5 +67,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     sys.exit(main())

@@ -1,26 +1,23 @@
 #!/usr/bin/env python
 """Static lint: every ``IGG_*`` knob must be declared and documented.
 
-The configuration tier's whole value is discoverability — an env var read
-deep inside a hot path that appears in neither `utils/config.py` nor
-`docs/usage.md` is a knob nobody can find (exactly how ``IGG_GATHER_BATCH``
-went undocumented for two rounds).  This lint closes the loop:
-
-* scan every ``.py`` under ``implicitglobalgrid_tpu/`` (excluding
-  ``utils/config.py`` itself — the declaration site) for ``IGG_[A-Z0-9_]+``
-  tokens;
-* each referenced knob must appear in ``utils/config.py`` (docstring table
-  or accessor) AND in ``docs/usage.md``.
-
-Run standalone (exits nonzero listing violations) or via the tier-1 test
-``tests/test_knob_lint.py`` — an undocumented knob fails the suite.
+Thin CLI wrapper over the ``knob-decl`` analyzer of ``igg.analysis``
+(`implicitglobalgrid_tpu/analysis/knobs.py` — the pass-registry home of
+the scan since ISSUE 6; run the whole suite with ``scripts/igg_lint.py``).
+The contract is unchanged: an env var read anywhere in the package that
+appears in neither `utils/config.py` nor `docs/usage.md` is a knob nobody
+can find (exactly how ``IGG_GATHER_BATCH`` went undocumented for two
+rounds) and exits nonzero.  The tier-1 test ``tests/test_knob_lint.py``
+calls `violations`/`referenced_knobs` directly and monkeypatches the path
+globals below.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import os
-import re
 import sys
+import types
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -28,51 +25,46 @@ PACKAGE = os.path.join(REPO, "implicitglobalgrid_tpu")
 CONFIG = os.path.join(PACKAGE, "utils", "config.py")
 USAGE = os.path.join(REPO, "docs", "usage.md")
 
-_KNOB = re.compile(r"IGG_[A-Z0-9_]+")
+
+def _load_knobs_standalone():
+    """Load `analysis/knobs.py` (+ its `core` dependency) WITHOUT importing
+    the package: this lint must keep working — and stay a millisecond text
+    scan — even when the package or its jax env is broken, which is exactly
+    when a standalone knob audit is most useful.  The modules are stitched
+    into a synthetic package so their relative imports resolve; both are
+    stdlib-only by design (analysis/core.py's layering contract)."""
+    name = "_igg_analysis_standalone"
+    if name in sys.modules:
+        return sys.modules[f"{name}.knobs"]
+    adir = os.path.join(PACKAGE, "analysis")
+    pkg = types.ModuleType(name)
+    pkg.__path__ = [adir]
+    sys.modules[name] = pkg
+    for mod in ("core", "knobs"):
+        spec = importlib.util.spec_from_file_location(
+            f"{name}.{mod}", os.path.join(adir, f"{mod}.py")
+        )
+        m = importlib.util.module_from_spec(spec)
+        sys.modules[f"{name}.{mod}"] = m
+        spec.loader.exec_module(m)
+    return sys.modules[f"{name}.knobs"]
 
 
-def _read(path: str) -> str:
-    with open(path, encoding="utf-8") as f:
-        return f.read()
+_knobs = _load_knobs_standalone()
 
 
 def referenced_knobs() -> dict[str, list[str]]:
     """``knob -> [repo-relative files referencing it]`` over the package,
     excluding the declaration site (utils/config.py)."""
-    refs: dict[str, list[str]] = {}
-    for dirpath, dirnames, filenames in os.walk(PACKAGE):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in filenames:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            if os.path.samefile(path, CONFIG):
-                continue
-            rel = os.path.relpath(path, REPO)
-            for knob in set(_KNOB.findall(_read(path))):
-                refs.setdefault(knob, []).append(rel)
-    return {k: sorted(v) for k, v in sorted(refs.items())}
+    return _knobs.referenced_knobs(REPO, PACKAGE, CONFIG)
 
 
 def violations() -> list[str]:
     """Human-readable lint failures (empty = clean)."""
-    declared = set(_KNOB.findall(_read(CONFIG)))
-    documented = set(_KNOB.findall(_read(USAGE)))
-    out = []
-    for knob, files in referenced_knobs().items():
-        where = ", ".join(files)
-        if knob not in declared:
-            out.append(
-                f"{knob} (referenced in {where}) is not declared in "
-                f"implicitglobalgrid_tpu/utils/config.py — add it to the "
-                f"knob table (and an accessor if it is read per call)"
-            )
-        if knob not in documented:
-            out.append(
-                f"{knob} (referenced in {where}) is not documented in "
-                f"docs/usage.md — add a row to the env-var table"
-            )
-    return out
+    return [
+        f"{f.message} — {f.fix_hint}"
+        for f in _knobs.knob_decl_findings(REPO, PACKAGE, CONFIG, USAGE)
+    ]
 
 
 def main() -> int:

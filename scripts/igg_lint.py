@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""igg-lint — run the `igg.analysis` static-analysis suite.
+
+Examples::
+
+    igg_lint.py --list                      # what passes exist
+    igg_lint.py --all                       # full suite (tier-1 runs this
+                                            #   in-process, test_lint_suite)
+    igg_lint.py knob-binding knob-decl      # a subset
+    igg_lint.py --all --changed-only        # fast mode: only analyzers
+                                            #   whose declared paths
+                                            #   intersect `git status`
+    igg_lint.py --all --json                # machine-readable report
+
+Exit code: 0 = clean (or WARNING-only), 1 = CRITICAL/ERROR findings
+(WARNINGs too under ``--strict``), 2 = an analyzer crashed.  Findings are
+suppressed through the baseline file (justified suppressions only —
+docs/static-analysis.md describes the workflow); ``--no-baseline`` shows
+the raw findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _ensure_devices() -> None:
+    """Stage the 8-device CPU mesh before first jax use (the tier-1 test
+    inherits conftest's identical staging; the traced-IR analyzers need
+    a multi-device mesh to exist)."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="igg_lint", description=__doc__)
+    p.add_argument("analyzers", nargs="*", help="analyzer names (see --list)")
+    p.add_argument("--all", action="store_true", help="run every analyzer")
+    p.add_argument("--list", action="store_true", dest="list_passes",
+                   help="list available analyzers and exit")
+    p.add_argument("--json", action="store_true", help="JSON report")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: the package baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (show raw findings)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="run only analyzers relevant to `git status` paths")
+    p.add_argument("--strict", action="store_true",
+                   help="WARNINGs also fail the run")
+    args = p.parse_args(argv)
+
+    from implicitglobalgrid_tpu import analysis
+
+    if args.list_passes:
+        from implicitglobalgrid_tpu.analysis.core import REGISTRY
+
+        for name, spec in REGISTRY.items():
+            print(f"{name:24s} [{spec.cost}]  {spec.title}")
+        return 0
+
+    if not args.all and not args.analyzers:
+        p.error("name analyzers to run, or pass --all (see --list)")
+    names = None if args.all else args.analyzers
+
+    needs_trace = True
+    if names is not None:
+        from implicitglobalgrid_tpu.analysis.core import REGISTRY
+
+        unknown = [n for n in names if n not in REGISTRY]
+        if unknown:
+            p.error(f"unknown analyzer(s): {unknown}")
+        needs_trace = any(REGISTRY[n].cost == "trace" for n in names)
+    if needs_trace:
+        _ensure_devices()
+
+    baseline = (
+        None
+        if args.no_baseline
+        else (args.baseline or analysis.DEFAULT_BASELINE)
+    )
+    changed = analysis.changed_files(REPO) if args.changed_only else None
+    report = analysis.run(
+        names,
+        baseline=baseline,
+        changed_paths=changed,
+        keep_going=True,
+    )
+    print(report.to_json() if args.json else report.human())
+    return report.exit_code(strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())

@@ -1,0 +1,774 @@
+"""Seeded fixtures for the `igg.analysis` suite (docs/static-analysis.md).
+
+Each analyzer is pinned BOTH ways: a deliberately-broken fixture it must
+fire on (a rank-divergent collective, a knob read inside jit, a bogus
+alias, a malformed perm), and a clean twin it must stay quiet on — an
+analyzer that cannot tell the two apart is a broken lint, not a clean
+tree.  The framework itself (fingerprints, baseline workflow, changed-only
+selection, exit codes) is tested here too; the real package's full-suite
+run lives in `tests/test_lint_suite.py`.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from implicitglobalgrid_tpu.analysis import core
+from implicitglobalgrid_tpu.analysis.core import (
+    Baseline,
+    Context,
+    Finding,
+    Report,
+    select_for_paths,
+)
+
+
+def _fixture_ctx(tmp_path, sources: dict) -> Context:
+    """A Context whose package root is a throwaway package built from
+    ``{relative path: source}`` — the AST passes scan it instead of the
+    real package."""
+    pkg = tmp_path / "fixture_pkg"
+    for rel, src in sources.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Context(repo_root=str(tmp_path), package_root=str(pkg))
+
+
+# -- framework: Finding / fingerprints ---------------------------------------
+
+
+def test_finding_rejects_unknown_severity():
+    with pytest.raises(ValueError, match="severity"):
+        Finding(analyzer="a", code="c", severity="FATAL", message="m")
+
+
+def test_fingerprint_survives_message_and_line_drift():
+    a = Finding(analyzer="a", code="c", severity="ERROR", message="old",
+                path="p.py", line=10, symbol="f", anchor="K")
+    b = Finding(analyzer="a", code="c", severity="ERROR", message="reworded",
+                path="p.py", line=99, symbol="f", anchor="K")
+    c = Finding(analyzer="a", code="c", severity="ERROR", message="old",
+                path="p.py", line=10, symbol="f", anchor="OTHER")
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != c.fingerprint
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(
+        {"suppressions": [{"fingerprint": "abc", "justification": "  "}]}
+    ))
+    with pytest.raises(ValueError, match="justification"):
+        Baseline.load(str(path))
+    path.write_text(json.dumps(
+        {"suppressions": [{"fingerprint": "abc",
+                           "justification": "documented contract"}]}
+    ))
+    base = Baseline.load(str(path))
+    f = Finding(analyzer="a", code="c", severity="ERROR", message="m")
+    assert base.match(f) is None
+    assert "abc" in base.suppressions
+
+
+def test_shipped_baseline_is_well_formed():
+    base = Baseline.load(core.DEFAULT_BASELINE)
+    assert base.suppressions, "the shipped baseline lost its entries"
+    for entry in base.suppressions.values():
+        assert len(entry["justification"]) > 40  # a reason, not a mute
+
+
+def test_report_exit_codes():
+    err = Finding(analyzer="a", code="c", severity="ERROR", message="m")
+    warn = Finding(analyzer="a", code="c", severity="WARNING", message="m")
+    assert Report().exit_code() == 0
+    assert Report(findings=[warn]).exit_code() == 0
+    assert Report(findings=[warn]).exit_code(strict=True) == 1
+    assert Report(findings=[err]).exit_code() == 1
+    assert Report(errors={"a": "boom"}).exit_code() == 2
+
+
+# -- framework: runner + baseline + changed-only ------------------------------
+
+
+def _register_fake_analyzer(tmp_path, monkeypatch, body: str,
+                            modname: str = "igg_fake_pass"):
+    """Install a one-analyzer registry whose pass is ``body`` (a module
+    defining ``run(ctx)``), returning its name.  ``modname`` must be
+    unique per test — `AnalyzerSpec.load` goes through the import cache."""
+    mod = tmp_path / f"{modname}.py"
+    mod.write_text(textwrap.dedent(body))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.delitem(sys.modules, modname, raising=False)
+    spec = core.AnalyzerSpec(
+        name="fake", module=modname, func="run", title="fixture",
+        paths=("implicitglobalgrid_tpu/ops/**",),
+    )
+    monkeypatch.setattr(core, "REGISTRY", {"fake": spec})
+    return "fake"
+
+
+_FAKE_PASS = """
+    from implicitglobalgrid_tpu.analysis.core import Finding
+
+    def run(ctx):
+        yield Finding(analyzer="fake", code="seeded", severity="ERROR",
+                      message="seeded finding", symbol="s", anchor="a")
+"""
+
+
+def test_run_reports_and_baselines_and_flags_stale(tmp_path, monkeypatch):
+    _register_fake_analyzer(tmp_path, monkeypatch, _FAKE_PASS)
+    report = core.run(baseline=None)
+    assert [f.code for f in report.findings] == ["seeded"]
+    assert report.exit_code() == 1
+
+    fp = report.findings[0].fingerprint
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"suppressions": [
+        {"fingerprint": fp, "justification": "seeded fixture, intentional"},
+        {"fingerprint": "dead0000dead0000",
+         "justification": "left over from a removed pass"},
+    ]}))
+    report = core.run(baseline=str(base))
+    assert report.findings == []
+    assert report.exit_code() == 0  # suppressed + stale do not fail
+    assert [f.fingerprint for f, _ in report.suppressed] == [fp]
+    assert report.stale_suppressions == ["dead0000dead0000"]
+    assert "matched no finding" in report.human()
+
+
+def test_run_changed_only_selects_by_declared_paths(tmp_path, monkeypatch):
+    _register_fake_analyzer(tmp_path, monkeypatch, _FAKE_PASS)
+    hit = core.run(baseline=None,
+                   changed_paths=["implicitglobalgrid_tpu/ops/halo.py"])
+    assert hit.ran == ["fake"] and len(hit.findings) == 1
+    miss = core.run(baseline=None, changed_paths=["docs/usage.md"])
+    assert miss.ran == [] and miss.skipped == ["fake"]
+    assert miss.findings == []
+
+
+def test_run_keep_going_traps_analyzer_crashes(tmp_path, monkeypatch):
+    _register_fake_analyzer(
+        tmp_path, monkeypatch,
+        "def run(ctx):\n    raise RuntimeError('boom')\n",
+        modname="igg_fake_crashing_pass",
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        core.run(baseline=None)
+    report = core.run(baseline=None, keep_going=True)
+    assert "RuntimeError: boom" in report.errors["fake"]
+    assert report.exit_code() == 2
+
+
+def test_run_rejects_unknown_analyzer():
+    with pytest.raises(ValueError, match="unknown analyzer"):
+        core.run(["no-such-pass"])
+
+
+def test_changed_only_selection_of_the_real_registry():
+    # Framework changes select everything; subsystem paths select their
+    # declared analyzers; unrelated paths select nothing.
+    assert set(select_for_paths(["scripts/igg_lint.py"])) == set(core.REGISTRY)
+    ops = select_for_paths(["implicitglobalgrid_tpu/ops/halo.py"])
+    assert "collective-consistency" in ops and "collective-budget" in ops
+    docs = select_for_paths(["docs/usage.md"])
+    assert docs == ["knob-decl"]
+    assert select_for_paths(["README.md"]) == []
+
+
+# -- collective-consistency: rank census --------------------------------------
+
+
+def _census(sequences):
+    from implicitglobalgrid_tpu.analysis.ir import RankCensus
+
+    return RankCensus(name="fixture", sequences=sequences)
+
+
+def test_divergence_detector_fires_on_rank_divergent_collective():
+    from implicitglobalgrid_tpu.analysis.collectives import (
+        check_rank_consistency,
+    )
+
+    op_a = ("ppermute", ("x",), ("f32[8]",))
+    op_b = ("psum", ("x",), ("f32[8]",))
+    # rank 1 swaps the op kind at position 1 — the deadlock class
+    found = check_rank_consistency(
+        _census({0: (op_a, op_b), 1: (op_a, op_a)})
+    )
+    assert [f.code for f in found] == ["rank-divergent-sequence"]
+    assert found[0].severity == "CRITICAL"
+    assert "op 1" in found[0].message
+
+
+def test_divergence_detector_fires_on_sequence_length_mismatch():
+    from implicitglobalgrid_tpu.analysis.collectives import (
+        check_rank_consistency,
+    )
+
+    op = ("ppermute", ("x",), ("f32[8]",))
+    found = check_rank_consistency(_census({0: (op, op), 1: (op,)}))
+    assert len(found) == 1
+    assert "2 collective(s)" in found[0].message
+
+
+def test_divergence_detector_quiet_on_identical_sequences():
+    from implicitglobalgrid_tpu.analysis.collectives import (
+        check_rank_consistency,
+    )
+
+    op = ("ppermute", ("x",), ("f32[8]",))
+    assert check_rank_consistency(
+        _census({r: (op, op) for r in range(8)})
+    ) == []
+    assert check_rank_consistency(_census({})) == []
+
+
+def test_census_provider_registration_feeds_the_detector():
+    from implicitglobalgrid_tpu.analysis import collectives as C
+
+    def provider(ctx):
+        yield _census({0: (("psum", ("x",), ("f32[4]",)),), 1: ()})
+
+    C.register_census_provider(provider)
+    try:
+        found = C.host_plan_findings(Context())
+    finally:
+        C.CENSUS_PROVIDERS.remove(provider)
+    assert any(
+        f.code == "rank-divergent-sequence" and f.symbol == "fixture"
+        for f in found
+    )
+
+
+def test_gather_plan_census_is_clean_and_covers_the_real_plan():
+    """The PR-1 flaky-gather watch item as a static invariant: the real
+    `collective_plan` must be rank-independent over the census configs."""
+    from implicitglobalgrid_tpu.analysis import collectives as C
+
+    censuses = list(C.gather_plan_censuses(Context()))
+    assert len(censuses) == len(C._GATHER_PLAN_CONFIGS)
+    for census in censuses:
+        assert C.check_rank_consistency(census) == []
+        # every simulated rank present, root included
+        assert len(census.sequences) >= 1
+
+
+def test_gather_collective_plan_ignores_is_root_and_covers_ragged_tail():
+    import numpy as np
+
+    from implicitglobalgrid_tpu.ops.gather import collective_plan
+
+    dims, batch = (3, 2), 4  # 6 blocks, batch 4 -> one ragged tail of 2
+    root_plan = collective_plan(dims, batch, is_root=True)
+    assert root_plan == collective_plan(dims, batch, is_root=False)
+    sizes = [len(sels) for _, sels in root_plan]
+    assert sizes == [4, 2]
+    flat = [s for _, sels in root_plan for s in sels]
+    assert flat == list(range(int(np.prod(dims))))
+
+
+# -- collective-consistency: AST rank-guard pass ------------------------------
+
+
+_GUARDED = """
+    from jax import lax
+
+    def exchange(x, rank):
+        if rank == 0:
+            x = lax.psum(x, "x")
+        return x
+"""
+
+_CLEAN = """
+    from jax import lax
+
+    def exchange(x, rank):
+        x = lax.psum(x, "x")          # every rank, unconditionally
+        if rank == 0:
+            x = x * 2                 # rank-dependent HOST math is fine
+        if x.ndim == 3:
+            x = lax.pmax(x, "x")      # non-rank predicate is fine
+        return x
+"""
+
+
+def test_rank_guard_pass_fires_on_guarded_collective(tmp_path):
+    from implicitglobalgrid_tpu.analysis import collectives as C
+
+    ctx = _fixture_ctx(tmp_path, {"mod.py": _GUARDED})
+    found = C.ast_findings(ctx)
+    assert [f.code for f in found] == ["rank-guarded-collective"]
+    f = found[0]
+    assert f.severity == "CRITICAL" and f.symbol == "exchange"
+    assert f.anchor == "psum" and "rank" in f.message
+
+
+def test_rank_guard_pass_quiet_on_unconditional_collective(tmp_path):
+    from implicitglobalgrid_tpu.analysis import collectives as C
+
+    ctx = _fixture_ctx(tmp_path, {"mod.py": _CLEAN})
+    assert C.ast_findings(ctx) == []
+
+
+def test_rank_guard_pass_sees_the_early_return_form(tmp_path):
+    """The commonest shape of the PR-1 divergence: non-roots bail out
+    BEFORE the collective, so the collective sits after the guard, not
+    inside it."""
+    from implicitglobalgrid_tpu.analysis import collectives as C
+
+    src = """
+        from jax import lax
+
+        def exchange(x, rank):
+            if rank != 0:
+                return x
+            return lax.psum(x, "x")
+    """
+    found = C.ast_findings(_fixture_ctx(tmp_path / "pos", {"m.py": src}))
+    assert [f.code for f in found] == ["rank-guarded-collective"]
+    assert "rank" in found[0].message
+
+    # early return on a NON-rank predicate stays quiet
+    quiet = """
+        from jax import lax
+
+        def exchange(x):
+            if x.ndim != 3:
+                return x
+            return lax.psum(x, "x")
+    """
+    assert C.ast_findings(
+        _fixture_ctx(tmp_path / "neg", {"q.py": quiet})
+    ) == []
+
+
+def test_rank_guard_pass_sees_ternaries_and_nested_guards(tmp_path):
+    from implicitglobalgrid_tpu.analysis import collectives as C
+
+    src = """
+        from jax import lax
+
+        def f(x, gg):
+            return lax.psum(x, "x") if gg.coords[0] == 0 else x
+    """
+    found = C.ast_findings(_fixture_ctx(tmp_path, {"m.py": src}))
+    assert [f.code for f in found] == ["rank-guarded-collective"]
+    assert "coords" in found[0].message
+
+
+# -- collective-consistency: traced-census structure checks -------------------
+
+
+class _StubEntry:
+    name = "stub"
+    mesh_shape = {"x": 2}
+
+    def __init__(self, ops):
+        self._ops = ops
+
+    def collectives(self):
+        return self._ops
+
+
+def _op(perm, path=(), kind="ppermute"):
+    from implicitglobalgrid_tpu.analysis.ir import CollectiveOp
+
+    return CollectiveOp(kind=kind, axes=("x",), perm=perm, payload_bytes=0,
+                        shapes=("f32[4]",), path=path)
+
+
+def test_perm_checks_fire_on_malformed_permutes():
+    from implicitglobalgrid_tpu.analysis.collectives import _perm_findings
+
+    dup_src = _perm_findings(_StubEntry([_op(((0, 1), (0, 0)))]))
+    assert [f.code for f in dup_src] == ["malformed-permute"]
+    assert "duplicate sources" in dup_src[0].message
+
+    dup_dst = _perm_findings(_StubEntry([_op(((0, 1), (1, 1)))]))
+    assert "duplicate targets" in dup_dst[0].message
+
+    oob = _perm_findings(_StubEntry([_op(((0, 5),))]))
+    assert "outside the axis size" in oob[0].message
+
+
+def test_perm_checks_fire_on_collective_under_cond():
+    from implicitglobalgrid_tpu.analysis.collectives import _perm_findings
+
+    found = _perm_findings(
+        _StubEntry([_op(((0, 1), (1, 0)), path=("while", "cond"))])
+    )
+    assert [f.code for f in found] == ["collective-under-cond"]
+    assert found[0].severity == "CRITICAL"
+
+
+def test_perm_checks_quiet_on_valid_partial_permutation():
+    from implicitglobalgrid_tpu.analysis.collectives import _perm_findings
+
+    # a PROC_NULL-masked edge hop: partial perm, no dup, in range
+    assert _perm_findings(_StubEntry([_op(((0, 1),))])) == []
+
+
+# -- knob-binding -------------------------------------------------------------
+
+
+_KNOB_IN_TRACE = """
+    import os
+    from jax import jit
+
+    def body(x):
+        scale = int(os.environ.get("IGG_FIXTURE_SCALE", "1"))
+        return x * scale
+
+    stepper = jit(body)
+"""
+
+_KNOB_HOST_SIDE = """
+    import os
+    from jax import jit
+
+    def _scale():
+        return int(os.environ.get("IGG_FIXTURE_SCALE", "1"))
+
+    def make_step():
+        scale = _scale()              # resolved HOST-side, then closed over
+
+        def body(x):
+            return x * scale
+
+        return jit(body)
+"""
+
+
+def test_knob_binding_fires_on_env_read_inside_jit(tmp_path):
+    from implicitglobalgrid_tpu.analysis.knobs import run_knob_binding
+
+    found = run_knob_binding(_fixture_ctx(tmp_path, {"m.py": _KNOB_IN_TRACE}))
+    assert [f.code for f in found] == ["env-read-in-trace"]
+    f = found[0]
+    assert f.anchor == "IGG_FIXTURE_SCALE" and f.severity == "ERROR"
+    assert "TRACE time" in f.message
+
+
+def test_knob_binding_quiet_when_knob_resolved_host_side(tmp_path):
+    from implicitglobalgrid_tpu.analysis.knobs import run_knob_binding
+
+    found = run_knob_binding(
+        _fixture_ctx(tmp_path, {"m.py": _KNOB_HOST_SIDE})
+    )
+    assert found == []
+
+
+def test_knob_binding_follows_calls_and_accessor_args(tmp_path):
+    """The package idiom: a traced closure calling an accessor that calls
+    the generic reader — the knob name rides the constant first arg."""
+    from implicitglobalgrid_tpu.analysis.knobs import run_knob_binding
+
+    src = """
+        import os
+        from jax import lax
+        from .cfg import int_env
+
+        def make(n):
+            def inner(c, x):
+                return c, x * int_env("IGG_FIXTURE_DEPTH")
+
+            def body(x):
+                return lax.scan(inner, 0, x)
+
+            return body
+    """
+    cfg = """
+        import os
+
+        def int_env(name):
+            return int(os.environ.get(name, "0"))
+    """
+    found = run_knob_binding(
+        _fixture_ctx(tmp_path, {"m.py": src, "cfg.py": cfg})
+    )
+    assert [f.anchor for f in found] == ["IGG_FIXTURE_DEPTH"]
+
+
+def test_real_package_knob_binding_matches_the_baseline():
+    """Triage pin: every knob-binding finding on the REAL package is one of
+    the three baselined per-trace contracts — a new traced env read must
+    show up here (and fail tier-1 via test_lint_suite) until triaged."""
+    from implicitglobalgrid_tpu.analysis.knobs import run_knob_binding
+
+    base = Baseline.load(core.DEFAULT_BASELINE)
+    found = run_knob_binding(Context())
+    unbaselined = [f for f in found if base.match(f) is None]
+    assert unbaselined == [], [f.message for f in unbaselined]
+    assert {f.anchor for f in found} == {
+        "IGG_COALESCE", "IGG_TELEMETRY", "IGG_VMEM_MB",
+    }
+
+
+# -- knob-decl ----------------------------------------------------------------
+
+
+def test_knob_decl_fires_on_undeclared_and_undocumented(tmp_path):
+    from implicitglobalgrid_tpu.analysis.knobs import knob_decl_findings
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text('import os\nos.environ.get("IGG_BOGUS")\n')
+    config = tmp_path / "config.py"
+    config.write_text('"""knob table: (none)"""\n')
+    usage = tmp_path / "usage.md"
+    usage.write_text("# usage\n")
+    found = knob_decl_findings(str(tmp_path), str(pkg), str(config),
+                               str(usage))
+    assert sorted(f.code for f in found) == [
+        "undeclared-knob", "undocumented-knob",
+    ]
+    assert all(f.symbol == "IGG_BOGUS" for f in found)
+
+    config.write_text('"""table: IGG_BOGUS"""\n')
+    usage.write_text("| `IGG_BOGUS` | fixture row |\n")
+    assert knob_decl_findings(str(tmp_path), str(pkg), str(config),
+                              str(usage)) == []
+
+
+# -- pallas-aliasing ----------------------------------------------------------
+
+
+def test_alias_pair_validation_fires_on_bogus_pairs():
+    from implicitglobalgrid_tpu.analysis.aliasing import validate_alias_pairs
+
+    a = ((8, 8), "float32")
+    b = ((8, 9), "float32")
+    assert validate_alias_pairs([(0, 0)], [a], [a]) == []
+    assert "out of range" in validate_alias_pairs([(2, 0)], [a], [a])[0]
+    assert "out of range" in validate_alias_pairs([(0, 3)], [a], [a])[0]
+    probs = validate_alias_pairs([(0, 0), (1, 0)], [a, a], [a])
+    assert any("two inputs" in p for p in probs)
+    probs = validate_alias_pairs([(0, 0)], [b], [a])
+    assert any("shape+dtype" in p for p in probs)
+
+
+_BAD_ALIAS = """
+    import jax.experimental.pallas as pl
+
+    def build(kernel, shapes):
+        return pl.pallas_call(
+            kernel, out_shape=shapes,
+            input_output_aliases={0: 0, 1: 0},
+        )
+"""
+
+_GOOD_ALIAS = """
+    import jax.experimental.pallas as pl
+
+    def build(kernel, shapes):
+        return pl.pallas_call(
+            kernel, out_shape=shapes,
+            input_output_aliases={0: 0, 1: 1},
+        )
+"""
+
+
+def test_aliasing_ast_pass_fires_on_duplicate_output_alias(tmp_path):
+    from implicitglobalgrid_tpu.analysis import aliasing
+
+    found = aliasing.ast_findings(
+        _fixture_ctx(tmp_path, {"k.py": _BAD_ALIAS})
+    )
+    assert [f.code for f in found] == ["bad-alias-literal"]
+    assert "two inputs on one" in found[0].message
+
+
+def test_aliasing_ast_pass_quiet_on_injective_alias(tmp_path):
+    from implicitglobalgrid_tpu.analysis import aliasing
+
+    assert aliasing.ast_findings(
+        _fixture_ctx(tmp_path, {"k.py": _GOOD_ALIAS})
+    ) == []
+
+
+def test_aliasing_ast_pass_fires_on_negative_donation(tmp_path):
+    from implicitglobalgrid_tpu.analysis import aliasing
+
+    src = """
+        from jax import jit
+
+        def make(f):
+            return jit(f, donate_argnums=(-1,))
+    """
+    found = aliasing.ast_findings(_fixture_ctx(tmp_path, {"d.py": src}))
+    assert [f.code for f in found] == ["bad-donate-literal"]
+
+
+# -- overlap-independence -----------------------------------------------------
+
+
+def _shard_mapped_jaxpr(body, nargs=1):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from implicitglobalgrid_tpu.analysis.ir import unwrap_inner
+    from implicitglobalgrid_tpu.utils.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    mapped = shard_map(body, mesh=mesh, in_specs=(P("x"),) * nargs,
+                       out_specs=(P("x"),) * nargs, check_vma=False)
+    args = (jnp.zeros((8,), jnp.float32),) * nargs
+    return unwrap_inner(jax.make_jaxpr(mapped)(*args).jaxpr)
+
+
+def test_independence_pairs_counts_dataflow_freedom():
+    from jax import lax
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.analysis.ir import independence_pairs
+
+    ring = [(0, 1), (1, 0)]
+    is_k = lambda e: e.primitive.name == "sin"  # noqa: E731
+
+    def dependent(x):
+        return (jnp.sin(lax.ppermute(x, "x", ring)),)
+
+    pairs, nk, nc = independence_pairs(
+        _shard_mapped_jaxpr(dependent), is_kernel=is_k
+    )
+    assert (pairs, nk, nc) == (0, 1, 1)
+
+    def independent(x, z):
+        return jnp.sin(x), lax.ppermute(z, "x", ring)
+
+    pairs, nk, nc = independence_pairs(
+        _shard_mapped_jaxpr(independent, nargs=2), is_kernel=is_k
+    )
+    assert (pairs, nk, nc) == (1, 1, 1)
+
+
+def test_eqn_presence_classifies_collective_envelopes():
+    """A pjit/custom-vjp envelope whose body is all collectives must join
+    the census as a collective (the coalesced `_packed_transport` shape);
+    one containing none of either stays out."""
+    import jax
+    from jax import lax
+
+    from implicitglobalgrid_tpu.analysis.ir import _eqn_presence
+
+    ring = [(0, 1), (1, 0)]
+
+    def body(x):
+        wrapped = jax.jit(lambda v: lax.ppermute(v, "x", ring))
+        return (wrapped(x) + 1.0,)
+
+    jaxpr = _shard_mapped_jaxpr(body)
+    by_name = {e.primitive.name: e for e in jaxpr.eqns}
+    assert _eqn_presence(by_name["pjit"]) == (False, True)
+    assert _eqn_presence(by_name["add"]) == (False, False)
+
+
+# -- collective-budget --------------------------------------------------------
+
+
+def _hlo_fixture(n_perm: int, *, bad_start: bool = False) -> str:
+    """Synthetic optimized-HLO text with ``n_perm`` collective-permutes in
+    the shape `utils.hlo_analysis.collective_payloads` parses."""
+    lines = ["ENTRY %main (p0: f32[6,6]) -> f32[6,6] {",
+             "  %p0 = f32[6,6]{1,0} parameter(0)"]
+    for i in range(n_perm):
+        lines.append(
+            f"  %cp{i} = f32[6,6]{{1,0}} collective-permute(%p0), "
+            f"source_target_pairs={{{{0,1}},{{1,0}}}}"
+        )
+    if bad_start:
+        # async-start whose tuple halves do NOT match -> raw-sum fallback
+        lines.append(
+            "  %cps = (f32[6,6]{1,0}, f32[4,6]{1,0}, u32[]) "
+            "collective-permute-start(%p0), source_target_pairs={{0,1}}"
+        )
+    lines += ["  ROOT %r = f32[6,6]{1,0} add(%p0, %p0)", "}"]
+    return "\n".join(lines)
+
+
+def test_hlo_budget_cross_check_fires_and_stays_quiet():
+    from implicitglobalgrid_tpu.analysis.budget import hlo_budget_findings
+
+    # porous budget: 1 pair x 3 dims = 6 permutes allowed
+    assert hlo_budget_findings(_hlo_fixture(6)) == []
+
+    over = hlo_budget_findings(_hlo_fixture(8))
+    assert [f.code for f in over] == ["hlo-budget-exceeded"]
+    assert "split the coalesced hops" in over[0].message
+
+    empty = hlo_budget_findings(_hlo_fixture(0))
+    assert "hlo-census-broken" in [f.code for f in empty]
+
+
+def test_hlo_budget_cross_check_flags_unaccounted_payloads():
+    from implicitglobalgrid_tpu.analysis.budget import hlo_budget_findings
+
+    found = hlo_budget_findings(_hlo_fixture(5, bad_start=True))
+    assert [f.code for f in found] == ["hlo-payload-fallback"]
+    assert found[0].severity == "WARNING"
+
+
+def test_entry_budget_census_fires_on_per_field_regression():
+    """The suite path counts the SHARED traced entries: a coalesce=True
+    entry showing per-field collective counts must fire, and a control
+    entry that lost its collectives must flag the census itself."""
+    from implicitglobalgrid_tpu.analysis.budget import entry_budget_findings
+
+    from implicitglobalgrid_tpu.analysis.ir import CollectiveOp
+
+    def entry(name, axis_counts):
+        ops = []
+        for axis, cnt in axis_counts.items():
+            ops += [
+                CollectiveOp(kind="ppermute", axes=(axis,), perm=((0, 1),),
+                             payload_bytes=0, shapes=("f32[4]",), path=())
+            ] * cnt
+        stub = _StubEntry(ops)
+        stub.name = name
+        return stub
+
+    # diffusion (1 field): coalesced entry regressed to 6 permutes in x
+    found = entry_budget_findings(
+        [
+            entry("exchange/diffusion[coalesce=True]", {"x": 6, "y": 2, "z": 2}),
+            entry("exchange/diffusion[coalesce=False]", {"x": 2}),
+        ],
+        budget_pairs={"diffusion": 1},
+    )
+    assert [f.code for f in found] == ["budget-exceeded"]
+    assert found[0].symbol == "diffusion/dim0"
+
+    # clean twin stays quiet
+    assert entry_budget_findings(
+        [
+            entry("exchange/diffusion[coalesce=True]", {"x": 2, "y": 2, "z": 2}),
+            entry("exchange/diffusion[coalesce=False]", {"x": 2}),
+        ],
+        budget_pairs={"diffusion": 1},
+    ) == []
+
+    # a missing entry is a broken census, not a clean run
+    assert [
+        f.code
+        for f in entry_budget_findings([], budget_pairs={"diffusion": 1})
+    ] == ["census-broken"]
+
+
+def test_budget_analyzer_fires_when_budget_tightened_to_zero():
+    """Liveness: with an impossible budget the census must report every
+    exchanged dimension — proving it sees the real collectives (the clean
+    run on the true budget is tier-1's test_collective_budget)."""
+    from implicitglobalgrid_tpu.analysis.budget import budget_findings
+
+    found = budget_findings(budget_pairs={"diffusion": 0})
+    assert [f.code for f in found] == ["budget-exceeded"] * 3
+    assert {f.symbol for f in found} == {
+        "diffusion/dim0", "diffusion/dim1", "diffusion/dim2",
+    }
