@@ -408,6 +408,19 @@ def main():
             "pallas_fused4" if fused.get("path") == "pallas-fused"
             else "xla_fallback_cadence"
         )
+    # Perf-regression verdict vs the newest committed BENCH round
+    # (docs/performance.md, perf-regression gate): the fresh record carries
+    # its own gate result so the driver (and scripts/check_perf.py) can
+    # refuse to commit a regressed artifact.
+    try:
+        from implicitglobalgrid_tpu.analysis.perf import gate_summary
+
+        extras["perf_gate"] = gate_summary(
+            {"value": best, "extras": extras},
+            os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception as e:  # never let the gate sink the artifact
+        extras["perf_gate"] = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {

@@ -108,7 +108,9 @@ class AnalyzerSpec:
     the analyzer runs iff some changed file matches one of them (changes to
     the analysis framework or its scripts always select every analyzer).
     ``cost``: ``"ast"`` passes parse source only; ``"trace"`` passes build
-    jaxprs on the 8-device virtual mesh (seconds, not milliseconds).
+    jaxprs on the 8-device virtual mesh (seconds, not milliseconds);
+    ``"compile"`` passes additionally build optimized HLO through XLA:CPU
+    (a few more seconds per program, cached per `Context`).
     """
 
     name: str
@@ -183,6 +185,9 @@ REGISTRY: dict[str, AnalyzerSpec] = {
                 "implicitglobalgrid_tpu/ops/**",
                 "implicitglobalgrid_tpu/models/**",
                 "implicitglobalgrid_tpu/parallel/**",
+                # hlo_analysis.py IS the byte census — a change there must
+                # re-run the gate that consumes it
+                "implicitglobalgrid_tpu/utils/**",
             ),
             cost="trace",
         ),
@@ -195,6 +200,46 @@ REGISTRY: dict[str, AnalyzerSpec] = {
             paths=("implicitglobalgrid_tpu/**", "docs/usage.md"),
             cost="ast",
         ),
+        AnalyzerSpec(
+            name="hlo-cost",
+            module="implicitglobalgrid_tpu.analysis.costmodel",
+            func="run",
+            title="static HLO cost model of the production config matrix "
+            "vs the versioned cost baseline (bytes, flops, payloads, "
+            "launches, peak buffers)",
+            paths=(
+                "implicitglobalgrid_tpu/ops/**",
+                "implicitglobalgrid_tpu/models/**",
+                "implicitglobalgrid_tpu/parallel/**",
+                # the cost census is produced BY utils/hlo_analysis.py —
+                # the gate must re-run when its own parser changes
+                "implicitglobalgrid_tpu/utils/**",
+            ),
+            cost="compile",
+        ),
+        AnalyzerSpec(
+            name="grad-soundness",
+            module="implicitglobalgrid_tpu.analysis.gradflow",
+            func="run",
+            title="cotangent-dropping primitives on the tangent path + "
+            "backward-collective census of every differentiable entry "
+            "point (the PR-5 zero-gradient-sink class)",
+            paths=(
+                "implicitglobalgrid_tpu/ops/**",
+                "implicitglobalgrid_tpu/models/**",
+            ),
+            cost="trace",
+        ),
+        AnalyzerSpec(
+            name="bench-regression",
+            module="implicitglobalgrid_tpu.analysis.perf",
+            func="run",
+            title="committed bench trajectory within per-metric tolerance "
+            "bands (scripts/check_perf.py; waivers in "
+            "analysis/perf_waivers.json)",
+            paths=("BENCH_*.json", "bench.py", "benchmarks/**"),
+            cost="ast",
+        ),
     )
 }
 
@@ -204,6 +249,8 @@ _SELF_PATHS = (
     "scripts/igg_lint.py",
     "scripts/check_collectives.py",
     "scripts/check_knobs.py",
+    "scripts/check_perf.py",
+    "scripts/refresh_cost_baseline.py",
 )
 
 
@@ -250,7 +297,8 @@ class Context:
         self._asts = None
         self._exchange = None
         self._cadence = None
-        self._hlo = None
+        self._grad = None
+        self._compiled = {}
 
     # AST IR ------------------------------------------------------------
 
@@ -297,16 +345,39 @@ class Context:
             self._cadence = ir.trace_cadence_entries()
         return self._cadence
 
-    # Optimized-HLO IR ----------------------------------------------------
-
-    def exchange_hlo(self) -> str:
-        """Optimized-HLO text of the porous coalesced exchange (the only
-        COMPILED IR — one small XLA:CPU build, `ir.compile_exchange_hlo`)."""
-        if self._hlo is None:
+    def grad_entries(self):
+        """Traced VJP programs of the differentiable entry points (all
+        models' coalesced exchange + fused cadences, `ir.trace_grad_entries`)."""
+        if self._grad is None:
             from . import ir
 
-            self._hlo = ir.compile_exchange_hlo()
-        return self._hlo
+            self._grad = ir.trace_grad_entries()
+        return self._grad
+
+    # Compiled IR (optimized HLO + toolchain stats) -----------------------
+
+    def compiled_program(self, name: str):
+        """One compiled program of `ir.COMPILED_MATRIX`, cached per config —
+        the budget analyzer's HLO cross-check and the cost model's census
+        share ONE compile of each program instead of rebuilding it."""
+        if name not in self._compiled:
+            from . import ir
+
+            self._compiled[name] = ir.compile_program(name)
+        return self._compiled[name]
+
+    def compiled_programs(self) -> dict:
+        """The full compiled matrix (`{name: ir.CompiledProgram}`)."""
+        from . import ir
+
+        return {n: self.compiled_program(n) for n in ir.COMPILED_MATRIX}
+
+    def exchange_hlo(self) -> str:
+        """Optimized-HLO text of the porous coalesced exchange (one small
+        XLA:CPU build, shared with the cost model's census)."""
+        from . import ir
+
+        return self.compiled_program(ir.EXCHANGE_HLO_PROGRAM).text
 
 
 # -- Baseline (suppression file) ----------------------------------------------
@@ -503,29 +574,95 @@ def run(
     return report
 
 
-def changed_files(repo_root: str | None = None) -> list[str]:
-    """Repo-relative paths changed vs HEAD (staged + worktree + untracked) —
-    the ``--changed-only`` census.  Empty when git is unavailable."""
+def ensure_cpu_devices(n: int = 8) -> None:
+    """Stage an ``n``-device XLA:CPU mesh before first backend use.
+
+    The one staging recipe shared by every CLI driver of the suite
+    (``igg_lint.py``, ``refresh_cost_baseline.py``; the tier-1 tests
+    inherit conftest's identical dance): `XLA_FLAGS` must be set before
+    the backend initializes, and the `jax_num_cpu_devices` config option
+    does not exist on older installs.  Call it before the first
+    `jax.devices()` — it is a no-op guard, not a backend reset.
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    elif int(m.group(1)) != n:
+        # Silently keeping a pre-staged wrong count would surface later as
+        # a confusing mesh-size error (or a census on the wrong mesh).
+        raise RuntimeError(
+            f"XLA_FLAGS already stages "
+            f"--xla_force_host_platform_device_count={m.group(1)}, but the "
+            f"analysis suite needs {n} devices — unset it (or set it to "
+            f"{n}) before running."
+        )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # pre-0.4.38 installs: XLA_FLAGS alone carries it
+        pass
+
+
+def changed_files(repo_root: str | None = None,
+                  ref: str | None = None) -> list[str]:
+    """Repo-relative changed paths — the ``--changed-only`` census.
+
+    ``ref=None`` (the default): `git status --porcelain` — staged, worktree
+    and untracked changes vs HEAD; empty when git is unavailable (no fast
+    mode, back-compat).  ``ref="main"`` (or any committish): the union of
+    the merge-base diff against ``ref`` AND the status paths — what a PR
+    branch changed even on a CLEAN CI checkout, where `git status` selects
+    nothing.  In ref mode a git failure RAISES instead of returning empty:
+    silently selecting zero analyzers on a bad ref would green-light a PR
+    that was never linted.
+    """
     import subprocess
 
     root = repo_root or Context().repo_root
-    try:
-        out = subprocess.run(
-            ["git", "status", "--porcelain"],
-            cwd=root,
-            capture_output=True,
-            text=True,
-            timeout=30,
-            check=True,
+
+    def _git(*args) -> str:
+        return subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+            timeout=30, check=True,
         ).stdout
-    except Exception:  # noqa: BLE001 — no git, no fast mode
-        return []
+
     paths = []
-    for line in out.splitlines():
+    try:
+        status = _git("status", "--porcelain")
+    except Exception as e:  # noqa: BLE001 — no git
+        if ref is not None:
+            raise RuntimeError(
+                f"--changed-only={ref}: git status failed in {root}: {e}"
+            ) from e
+        return []
+    for line in status.splitlines():
         if len(line) < 4:
             continue
         p = line[3:].strip()
         if " -> " in p:  # renames list "old -> new"
             p = p.split(" -> ", 1)[1]
         paths.append(p.strip('"'))
+    if ref is None:
+        return paths
+    try:
+        base = _git("merge-base", "HEAD", ref).strip()
+        diff = _git("diff", "--name-only", base, "HEAD")
+    except Exception as e:  # noqa: BLE001 — bad ref must not select zero
+        raise RuntimeError(
+            f"--changed-only={ref}: merge-base diff failed in {root} "
+            f"(is {ref!r} a valid ref?): {e}"
+        ) from e
+    seen = set(paths)
+    for p in diff.splitlines():
+        p = p.strip()
+        if p and p not in seen:
+            seen.add(p)
+            paths.append(p)
     return paths

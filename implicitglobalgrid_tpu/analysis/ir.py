@@ -218,8 +218,13 @@ def model_field_structs(model: str, n: int):
     raise ValueError(model)
 
 
-def _trace_mapped(body, fields, gg):
-    """shard_map + make_jaxpr a local-block body over global-shaped args."""
+def _trace_mapped(body, fields, gg, out_fields=None):
+    """shard_map + make_jaxpr a local-block body over global-shaped args.
+
+    ``out_fields`` overrides the output structure when it differs from the
+    inputs (a traced VJP takes seeds + primals but returns one cotangent
+    per primal — `trace_grad_entries`); default: outputs mirror inputs.
+    """
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -227,8 +232,14 @@ def _trace_mapped(body, fields, gg):
     from ..utils.compat import shard_map
 
     specs = tuple(P(*AXIS_NAMES[: f.ndim]) for f in fields)
+    out_specs = (
+        specs
+        if out_fields is None
+        else tuple(P(*AXIS_NAMES[: f.ndim]) for f in out_fields)
+    )
     mapped = shard_map(
-        body, mesh=gg.mesh, in_specs=specs, out_specs=specs, check_vma=False
+        body, mesh=gg.mesh, in_specs=specs, out_specs=out_specs,
+        check_vma=False,
     )
     gargs = tuple(
         jax.ShapeDtypeStruct(
@@ -326,17 +337,89 @@ def trace_exchange_entries(n: int = 8) -> list:
     return entries
 
 
-def compile_exchange_hlo(model: str = "porous", n: int = 6) -> str:
-    """Optimized-HLO text of one model's coalesced production exchange —
-    the third IR (`utils.hlo_analysis` parses it).
+# -- compiled programs (the optimized-HLO IR) ---------------------------------
 
-    Unlike the jaxpr producers this COMPILES (XLA:CPU on the 8-device
-    mesh), so only the richest single program is built: the porous 5-field
-    exchange over all three dimensions, where the PR-5 message-combining
-    evidence (30 → 6 collective-permutes) lives.  The budget analyzer's
-    HLO cross-check consumes it: the compiler must neither split the
-    coalesced hops back apart nor emit payloads `collective_payloads`
-    cannot account for.
+
+@dataclass(frozen=True)
+class CompiledProgram:
+    """One XLA:CPU-compiled program of the config matrix.
+
+    ``text`` is the optimized-HLO text (`utils.hlo_analysis` parses it);
+    ``memory``/``cost`` carry the toolchain's own buffer-assignment and
+    cost-analysis numbers (`memory_analysis`/`cost_analysis` — empty dicts
+    where a toolchain does not expose them, and the cost model reports
+    that as a lost metric rather than silently passing).
+    """
+
+    name: str
+    kind: str            # "exchange" | "cadence"
+    config: dict
+    text: str
+    memory: dict
+    cost: dict
+
+
+#: The compiled half of the config matrix.  The exchange program shares its
+#: NAME (and grid/field config) with the traced entry of the same name, so
+#: the cost model's payload cross-check compares the SAME program across
+#: the jaxpr and optimized-HLO IRs.  Cadences compile pipelined=True — the
+#: production schedule whose fusion/collective structure the baseline pins.
+EXCHANGE_HLO_PROGRAM = "exchange/porous[coalesce=True]"
+COMPILED_MATRIX = (
+    EXCHANGE_HLO_PROGRAM,
+    "cadence/diffusion[pipelined=True]",
+    "cadence/acoustic[pipelined=True]",
+    "cadence/porous[pipelined=True]",
+)
+
+
+def _compiled_stats(compiled) -> tuple[dict, dict]:
+    """(memory, cost) numbers of one compiled executable, best-effort."""
+    memory, cost = {}, {}
+    try:
+        ma = compiled.memory_analysis()
+        memory = {
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+        }
+    except Exception:  # noqa: BLE001 — backend without memory stats
+        pass
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        for key, out in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+            if key in ca:
+                cost[out] = float(ca[key])
+    except Exception:  # noqa: BLE001 — backend without cost analysis
+        pass
+    return memory, cost
+
+
+def compile_program(name: str) -> CompiledProgram:
+    """Compile one named program of `COMPILED_MATRIX` (XLA:CPU).
+
+    Callers go through `core.Context.compiled_program`, which caches per
+    config — the budget analyzer's HLO cross-check and the cost model's
+    census share ONE compile of the exchange instead of building it twice.
+    """
+    if name == EXCHANGE_HLO_PROGRAM:
+        return _compile_exchange_program()
+    for model in ("diffusion", "acoustic", "porous"):
+        if name == f"cadence/{model}[pipelined=True]":
+            return _compile_cadence_program(model)
+    raise ValueError(
+        f"unknown compiled program {name!r}; matrix: {COMPILED_MATRIX}"
+    )
+
+
+def _compile_exchange_program(model: str = "porous", n: int = 8) -> CompiledProgram:
+    """The porous 5-field coalesced exchange, compiled.
+
+    The richest exchange program — where the PR-5 message-combining
+    evidence (30 → 6 collective-permutes) lives.  Same grid (2,2,2)
+    periodic-z and same ``n`` as `trace_exchange_entries`, so the traced
+    twin of the same name is byte-comparable hop for hop.
     """
     import jax
 
@@ -370,9 +453,24 @@ def compile_exchange_hlo(model: str = "porous", n: int = 6) -> str:
             )
             for f in fields
         )
-        return jax.jit(mapped).lower(*gargs).compile().as_text()
+        compiled = jax.jit(mapped).lower(*gargs).compile()
+        memory, cost = _compiled_stats(compiled)
+        return CompiledProgram(
+            name=f"exchange/{model}[coalesce=True]",
+            kind="exchange",
+            config={"model": model, "n": n, "coalesce": True},
+            text=compiled.as_text(),
+            memory=memory,
+            cost=cost,
+        )
     finally:
         igg.finalize_global_grid()
+
+
+def compile_exchange_hlo(model: str = "porous", n: int = 8) -> str:
+    """Optimized-HLO text of the porous coalesced exchange (back-compat
+    text-only view of `_compile_exchange_program`)."""
+    return _compile_exchange_program(model, n).text
 
 
 #: Cadence matrix: one admissible pipelined config per model (from the
@@ -386,6 +484,28 @@ _CADENCES = (
                     periods={"periodz": 1}, npt=5)),
 )
 
+_MODEL_MODULES = {
+    "diffusion": "diffusion3d",
+    "acoustic": "acoustic3d",
+    "porous": "porous_convection3d",
+}
+
+
+def _cadence_setup_kwargs(cfg) -> dict:
+    """`setup(...)` kwargs of one cadence config (2-device x-split grid)."""
+    import jax
+    import jax.numpy as jnp
+
+    kw = dict(
+        devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        overlapx=2 * cfg["k"], overlapy=2 * cfg["k"],
+        overlapz=2 * cfg["k"], quiet=True, dtype=jnp.float32,
+        **cfg["periods"],
+    )
+    if "npt" in cfg:
+        kw["npt"] = cfg["npt"]
+    return kw
+
 
 def trace_cadence_entries() -> list:
     """Trace each model's fused multi-step cadence, pipelined on/off.
@@ -398,7 +518,6 @@ def trace_cadence_entries() -> list:
     import importlib
 
     import jax
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     import implicitglobalgrid_tpu as igg
@@ -407,21 +526,13 @@ def trace_cadence_entries() -> list:
     entries = []
     for model, cfg in _CADENCES:
         mod = importlib.import_module(
-            f"implicitglobalgrid_tpu.models."
-            + {"diffusion": "diffusion3d", "acoustic": "acoustic3d",
-               "porous": "porous_convection3d"}[model]
+            "implicitglobalgrid_tpu.models." + _MODEL_MODULES[model]
         )
         for pipelined in (False, True):
-            kw = dict(
-                devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
-                overlapx=2 * cfg["k"], overlapy=2 * cfg["k"],
-                overlapz=2 * cfg["k"], quiet=True, dtype=jnp.float32,
-                **cfg["periods"],
-            )
-            if "npt" in cfg:
-                kw["npt"] = cfg["npt"]
             try:
-                state, params = mod.setup(*cfg["nloc"], **kw)
+                state, params = mod.setup(
+                    *cfg["nloc"], **_cadence_setup_kwargs(cfg)
+                )
                 admissible = True
                 with pallas_force_interpret():
                     with warnings.catch_warnings(record=True) as caught:
@@ -462,6 +573,199 @@ def trace_cadence_entries() -> list:
                     admissible=admissible,
                 )
             )
+    return entries
+
+
+def _compile_cadence_program(model: str) -> CompiledProgram:
+    """Compile one model's fused cadence (pipelined=True, the `_CADENCES`
+    config) through the generic Pallas interpreter — the optimized-HLO view
+    of the production multi-step program the cost model pins.  One XLA:CPU
+    build per model, seconds each; `Context` caches the result."""
+    import importlib
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import implicitglobalgrid_tpu as igg
+    from ..utils.compat import pallas_force_interpret, shard_map
+
+    cfg = dict(_CADENCES)[model]
+    mod = importlib.import_module(
+        "implicitglobalgrid_tpu.models." + _MODEL_MODULES[model]
+    )
+    try:
+        state, params = mod.setup(*cfg["nloc"], **_cadence_setup_kwargs(cfg))
+        with pallas_force_interpret():
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                step = mod.make_multi_step(
+                    params, cfg["nt"], donate=False,
+                    fused_k=cfg["k"], fused_tile=cfg["tile"],
+                    pipelined=True,
+                )
+                gg = igg.get_global_grid()
+                nf = len(state)
+                mapped = shard_map(
+                    step.__wrapped__, mesh=gg.mesh,
+                    in_specs=(P(*igg.AXIS_NAMES),) * nf,
+                    out_specs=(P(*igg.AXIS_NAMES),) * nf,
+                    check_vma=False,
+                )
+                compiled = jax.jit(mapped).lower(*state).compile()
+        memory, cost = _compiled_stats(compiled)
+        return CompiledProgram(
+            name=f"cadence/{model}[pipelined=True]",
+            kind="cadence",
+            config={"model": model, "pipelined": True, **cfg},
+            text=compiled.as_text(),
+            memory=memory,
+            cost=cost,
+        )
+    finally:
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
+
+
+# -- traced VJP producers (grad-soundness) ------------------------------------
+
+
+@dataclass(frozen=True)
+class GradTrace:
+    """One differentiable entry point traced through `jax.vjp`.
+
+    ``jaxpr`` is the inner jaxpr of the whole VJP program (forward replay +
+    backward pass — seeds and primals in, one cotangent per primal out);
+    ``primal_jaxpr`` is the matching primal-only trace.  The grad-soundness
+    census compares their collective counts: a cross-boundary cotangent
+    MUST ride collectives backward, so a VJP trace whose collective count
+    does not exceed the primal's has dropped its cross-rank gradient — the
+    PR-5 bitcast-without-VJP class, statically.
+    """
+
+    name: str
+    kind: str            # "exchange" | "cadence"
+    config: dict
+    jaxpr: object
+    primal_jaxpr: object
+
+    def collective_counts(self) -> tuple[int, int]:
+        """(grad_collectives, primal_collectives)."""
+        return (
+            len(collect_collectives(self.jaxpr)),
+            len(collect_collectives(self.primal_jaxpr)),
+        )
+
+
+def trace_grad_entries(n: int = 8) -> list:
+    """VJP traces of every differentiable entry point.
+
+    Two families (trace-only, no execution):
+
+    * the coalesced exchange of each model's production field set — the
+      `_packed_transport` custom-VJP path (PR 5's hand-written transpose);
+    * each model's fused multi-step cadence — the `fused_with_xla_grad`
+      family (primal replays the fused body, backward differentiates the
+      XLA twin).
+
+    Seeds are passed as leading ARGUMENTS (not synthesized inside), so the
+    traced program's cotangent outputs carry real dataflow from the seed
+    inputs — the census counts collectives, which only appear when the
+    backward pass actually transports cotangents across ranks.
+    """
+    import importlib
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import implicitglobalgrid_tpu as igg
+    from ..ops import halo
+    from ..utils.compat import pallas_force_interpret, shard_map
+
+    entries = []
+
+    # Exchange family: one grid, all models, coalesce=True (the packed
+    # transport whose custom VJP the census proves alive).
+    igg.init_global_grid(n, n, n, dimx=2, dimy=2, dimz=2, periodz=1,
+                         quiet=True)
+    try:
+        gg = igg.get_global_grid()
+        for model in ("diffusion", "acoustic", "porous"):
+            fields = model_field_structs(model, n)
+            nf = len(fields)
+
+            def body(*fs):
+                return halo.exchange_dims_multi(fs, (0, 1, 2), width=1,
+                                                coalesce=True)
+
+            def grad_body(*args, _body=body, _nf=nf):
+                seeds, prims = args[:_nf], args[_nf:]
+                _, vjp = jax.vjp(_body, *prims)
+                return vjp(tuple(seeds))
+
+            gj = _trace_mapped(grad_body, fields * 2, gg, out_fields=fields)
+            pj = _trace_mapped(body, fields, gg)
+            entries.append(
+                GradTrace(
+                    name=f"grad/exchange/{model}",
+                    kind="exchange",
+                    config={"model": model, "coalesce": True},
+                    jaxpr=unwrap_inner(gj.jaxpr),
+                    primal_jaxpr=unwrap_inner(pj.jaxpr),
+                )
+            )
+    finally:
+        igg.finalize_global_grid()
+
+    # Cadence family: the fused multi-step of each model (serialized
+    # schedule — the default production grad path; the pipelined twin's
+    # structure is covered by `overlap-independence`).
+    for model, cfg in _CADENCES:
+        mod = importlib.import_module(
+            "implicitglobalgrid_tpu.models." + _MODEL_MODULES[model]
+        )
+        try:
+            state, params = mod.setup(
+                *cfg["nloc"], **_cadence_setup_kwargs(cfg)
+            )
+            with pallas_force_interpret():
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    step = mod.make_multi_step(
+                        params, cfg["nt"], donate=False,
+                        fused_k=cfg["k"], fused_tile=cfg["tile"],
+                        pipelined=False,
+                    )
+                    gg = igg.get_global_grid()
+                    nf = len(state)
+
+                    def grad_body(*args, _step=step, _nf=nf):
+                        seeds, prims = args[:_nf], args[_nf:]
+                        _, vjp = jax.vjp(_step.__wrapped__, *prims)
+                        return vjp(tuple(seeds))
+
+                    specs = (P(*igg.AXIS_NAMES),) * nf
+                    mapped = shard_map(
+                        grad_body, mesh=gg.mesh, in_specs=specs * 2,
+                        out_specs=specs, check_vma=False,
+                    )
+                    gj = jax.make_jaxpr(mapped)(*state, *state)
+                    pm = shard_map(
+                        step.__wrapped__, mesh=gg.mesh, in_specs=specs,
+                        out_specs=specs, check_vma=False,
+                    )
+                    pj = jax.make_jaxpr(pm)(*state)
+        finally:
+            if igg.grid_is_initialized():
+                igg.finalize_global_grid()
+        entries.append(
+            GradTrace(
+                name=f"grad/cadence/{model}",
+                kind="cadence",
+                config={"model": model, **cfg},
+                jaxpr=unwrap_inner(gj.jaxpr),
+                primal_jaxpr=unwrap_inner(pj.jaxpr),
+            )
+        )
     return entries
 
 

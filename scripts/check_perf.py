@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""check_perf — the perf-regression gate over the BENCH trajectory.
+
+Compares a candidate bench record against a reference within per-metric
+tolerance bands (ROADMAP item 5: "a perf regression fails a PR the way a
+collective-count regression already does").  Defaults compare the two
+newest parseable committed rounds — the self-consistency check the
+``bench-regression`` tier-1 pass also runs; pass ``--candidate`` to gate a
+FRESH ``bench.py`` record before committing it.
+
+Examples::
+
+    check_perf.py                                # newest round vs previous
+    check_perf.py --candidate /tmp/bench.json    # fresh record vs newest
+    python bench.py | tail -1 > /tmp/b.json && check_perf.py -c /tmp/b.json
+    check_perf.py --tol 0.10 --json              # tighter band, machine out
+
+Exit code: 0 = within tolerance (waived regressions listed), 1 = at least
+one unwaived metric dropped beyond tolerance, 2 = the comparison is
+impossible (missing/unparseable records).  Waivers live in
+``implicitglobalgrid_tpu/analysis/perf_waivers.json`` — every entry
+requires a justification; ``--strict-waivers`` also fails on STALE waivers
+(entries that matched nothing — the tree moved on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="check_perf", description=__doc__)
+    p.add_argument("-c", "--candidate", default=None,
+                   help="candidate record (BENCH wrapper or raw bench.py "
+                        "JSON; default: the newest committed round)")
+    p.add_argument("--against", default=None,
+                   help="reference record file (default: the newest "
+                        "committed round below the candidate)")
+    p.add_argument("--tol", type=float, default=None,
+                   help="allowed fractional drop per metric "
+                        "(default 0.15)")
+    p.add_argument("--waivers", default=None,
+                   help="waiver file (default: the package waiver file)")
+    p.add_argument("--json", action="store_true", help="JSON verdict")
+    p.add_argument("--strict-waivers", action="store_true",
+                   help="stale waivers (matching nothing) also fail")
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu.analysis import perf
+
+    tol = perf.DEFAULT_TOL if args.tol is None else args.tol
+    # exit 2 = "comparison impossible" covers setup failures too: a typo'd
+    # path or malformed waiver file must not read as a perf regression (1)
+    try:
+        waivers = perf.load_waivers(args.waivers or perf.PERF_WAIVERS)
+    except (OSError, ValueError) as e:
+        print(f"check_perf: cannot load waivers: {e}", file=sys.stderr)
+        return 2
+
+    records, skipped = perf.load_bench_records(REPO)
+    cand_round = None
+    if args.candidate:
+        try:
+            cand = perf.parse_bench_file(args.candidate)
+        except OSError as e:
+            print(f"check_perf: cannot read {args.candidate}: {e}",
+                  file=sys.stderr)
+            return 2
+        if cand is None:
+            print(f"check_perf: {args.candidate} holds no parseable bench "
+                  f"record", file=sys.stderr)
+            return 2
+    elif records:
+        cand_round, cand = records[-1]
+        records = records[:-1]
+    else:
+        print("check_perf: no parseable committed BENCH records",
+              file=sys.stderr)
+        return 2
+
+    if args.against:
+        try:
+            ref = perf.parse_bench_file(args.against)
+        except OSError as e:
+            print(f"check_perf: cannot read {args.against}: {e}",
+                  file=sys.stderr)
+            return 2
+        ref_label = args.against
+        if ref is None:
+            print(f"check_perf: {args.against} holds no parseable bench "
+                  f"record", file=sys.stderr)
+            return 2
+    elif records:
+        ref_round, ref = records[-1]
+        ref_label = f"BENCH_r{ref_round:02d}.json"
+    else:
+        print("check_perf: no committed reference record to compare "
+              "against", file=sys.stderr)
+        return 2
+
+    cmp = perf.compare_metrics(
+        perf.gate_metrics(cand), perf.gate_metrics(ref),
+        tol=tol, waivers=waivers, candidate_round=cand_round,
+    )
+    used = {w["waiver_index"] for w in cmp["waived"]}
+    stale = [w for i, w in enumerate(waivers) if i not in used]
+    verdict = {
+        "ok": not cmp["regressions"]
+        and not (args.strict_waivers and stale),
+        "reference": ref_label,
+        "tol": tol,
+        **cmp,
+        "stale_waivers": [w["metric"] for w in stale],
+        "skipped_records": skipped,
+    }
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        for reg in cmp["regressions"]:
+            print(f"REGRESSION {reg['metric']}: {reg['reference']:.2f} -> "
+                  f"{reg['candidate']:.2f} GB/s ({reg['drop']:.1%} drop, "
+                  f"tolerance {tol:.0%})")
+        for w in cmp["waived"]:
+            print(f"waived     {w['metric']}: {w['drop']:.1%} drop — "
+                  f"{w['justification']}")
+        for m in cmp["missing"]:
+            print(f"note       {m}: present in reference, absent from "
+                  f"candidate (config retired?)")
+        for w in stale:
+            print(f"stale      waiver for {w['metric']} matched nothing — "
+                  f"remove it")
+        for s in skipped:
+            print(f"note       {s}: unparseable record, skipped")
+        state = "FAIL" if not verdict["ok"] else "OK"
+        print(f"check_perf: {state} ({cmp['checked']} metric(s) vs "
+              f"{ref_label}, tol {tol:.0%})")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

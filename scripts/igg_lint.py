@@ -10,7 +10,13 @@ Examples::
     igg_lint.py --all --changed-only        # fast mode: only analyzers
                                             #   whose declared paths
                                             #   intersect `git status`
+    igg_lint.py --all --changed-only=main   # CI mode: diff against the
+                                            #   merge-base with `main` (a
+                                            #   clean checkout has no
+                                            #   status paths)
     igg_lint.py --all --json                # machine-readable report
+    igg_lint.py --all --sarif out.sarif     # SARIF 2.1.0 for CI diff
+                                            #   annotation (code scanning)
 
 Exit code: 0 = clean (or WARNING-only), 1 = CRITICAL/ERROR findings
 (WARNINGs too under ``--strict``), 2 = an analyzer crashed.  Findings are
@@ -30,20 +36,12 @@ REPO = os.path.dirname(HERE)
 
 
 def _ensure_devices() -> None:
-    """Stage the 8-device CPU mesh before first jax use (the tier-1 test
-    inherits conftest's identical staging; the traced-IR analyzers need
-    a multi-device mesh to exist)."""
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
-    import jax
+    """Stage the 8-device CPU mesh before first jax use (the traced-IR
+    analyzers need a multi-device mesh; one shared recipe,
+    `analysis.core.ensure_cpu_devices`)."""
+    from implicitglobalgrid_tpu.analysis.core import ensure_cpu_devices
 
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", 8)
-    except AttributeError:
-        pass
+    ensure_cpu_devices()
 
 
 def main(argv=None) -> int:
@@ -57,8 +55,15 @@ def main(argv=None) -> int:
                    help="baseline file (default: the package baseline)")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline (show raw findings)")
-    p.add_argument("--changed-only", action="store_true",
-                   help="run only analyzers relevant to `git status` paths")
+    p.add_argument("--changed-only", nargs="?", const=True, default=None,
+                   metavar="REF",
+                   help="run only analyzers relevant to changed paths: "
+                        "bare = `git status` (local fast mode); =REF adds "
+                        "the merge-base diff against REF (CI mode, where a "
+                        "clean checkout has no status paths)")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write the report as SARIF 2.1.0 to PATH "
+                        "('-' = stdout) for CI diff annotation")
     p.add_argument("--strict", action="store_true",
                    help="WARNINGs also fail the run")
     args = p.parse_args(argv)
@@ -76,29 +81,76 @@ def main(argv=None) -> int:
         p.error("name analyzers to run, or pass --all (see --list)")
     names = None if args.all else args.analyzers
 
-    needs_trace = True
+    needs_mesh = True
     if names is not None:
         from implicitglobalgrid_tpu.analysis.core import REGISTRY
 
         unknown = [n for n in names if n not in REGISTRY]
         if unknown:
             p.error(f"unknown analyzer(s): {unknown}")
-        needs_trace = any(REGISTRY[n].cost == "trace" for n in names)
-    if needs_trace:
-        _ensure_devices()
+        needs_mesh = any(
+            REGISTRY[n].cost in ("trace", "compile") for n in names
+        )
+    if needs_mesh:
+        try:
+            _ensure_devices()
+        except RuntimeError as e:
+            # an environment/setup failure is a crash (2), never to be
+            # read as "lint findings" (1) by an exit-code-driven consumer
+            print(f"igg-lint: {e}", file=sys.stderr)
+            return 2
 
     baseline = (
         None
         if args.no_baseline
         else (args.baseline or analysis.DEFAULT_BASELINE)
     )
-    changed = analysis.changed_files(REPO) if args.changed_only else None
+    changed = None
+    if args.changed_only is not None:
+        ref = None if args.changed_only is True else args.changed_only
+        from implicitglobalgrid_tpu.analysis.core import REGISTRY
+
+        raw = sys.argv[1:] if argv is None else list(argv)
+        explicit_ref = any(a.startswith("--changed-only=") for a in raw)
+        if ref is not None and ref in REGISTRY and not explicit_ref:
+            # `--changed-only knob-binding` used to mean "fast mode, run
+            # knob-binding"; with the optional REF argparse would silently
+            # eat the analyzer name as a git ref.  Refuse the ambiguity —
+            # the literal `=` spelling (checked against the raw argv,
+            # argparse normalizes both forms) stays available for a
+            # branch that genuinely shares an analyzer's name.
+            p.error(
+                f"'--changed-only {ref}' parsed {ref!r} as a git ref, but "
+                f"it names an analyzer — write `--changed-only={ref}` for "
+                f"a ref of that name, or put analyzer names BEFORE the "
+                f"bare --changed-only flag"
+            )
+        try:
+            changed = analysis.changed_files(REPO, ref=ref)
+        except RuntimeError as e:
+            print(f"igg-lint: {e}", file=sys.stderr)
+            return 2
     report = analysis.run(
         names,
         baseline=baseline,
         changed_paths=changed,
         keep_going=True,
     )
+    if args.sarif:
+        import json as _json
+
+        from implicitglobalgrid_tpu.analysis.sarif import report_to_sarif
+
+        sarif_text = _json.dumps(report_to_sarif(report), indent=2,
+                                 sort_keys=True) + "\n"
+        if args.sarif == "-":
+            sys.stdout.write(sarif_text)
+            # stdout IS the artifact now: the report must not corrupt it
+            print(report.to_json() if args.json else report.human(),
+                  file=sys.stderr)
+            return report.exit_code(strict=args.strict)
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            f.write(sarif_text)
     print(report.to_json() if args.json else report.human())
     return report.exit_code(strict=args.strict)
 

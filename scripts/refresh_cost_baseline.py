@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""refresh_cost_baseline — re-emit ``analysis/cost_baseline.json``.
+
+Runs the ``hlo-cost`` census (compiles the production config matrix on the
+8-device XLA:CPU mesh) and rewrites the committed baseline.  The audit
+contract mirrors ``analysis/baseline.json``: every CHANGED metric must be
+justified, so the baseline records WHY each number moved, never just that
+it did::
+
+    refresh_cost_baseline.py --dry-run
+        # show what changed vs the committed baseline, write nothing
+    refresh_cost_baseline.py \\
+        --justify "cadence/porous[pipelined=True]::fusions=PR 8 splits the \\
+PT update into ragged chunks (bench shows +12%)" \\
+        --justify-all "toolchain bump to jaxlib X.Y re-fused the cadences"
+        # per-metric notes win over the catch-all
+
+``--justify`` keys are ``program::metric`` (repeatable); ``--justify-all``
+covers any remaining changes.  Unchanged metrics keep their existing
+justification.  Exit 0 = written (or clean dry run), 1 = changed metrics
+lack justification, 2 = census failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _ensure_devices() -> None:
+    """One shared mesh-staging recipe: `analysis.core.ensure_cpu_devices`
+    (the census must compile on the SAME mesh igg_lint gates on)."""
+    sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu.analysis.core import ensure_cpu_devices
+
+    ensure_cpu_devices()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="refresh_cost_baseline",
+                                description=__doc__)
+    p.add_argument("--justify", action="append", default=[],
+                   metavar="PROGRAM::METRIC=NOTE",
+                   help="justification for one changed metric (repeatable)")
+    p.add_argument("--justify-all", default=None, metavar="NOTE",
+                   help="justification for every otherwise-unjustified "
+                        "changed metric")
+    p.add_argument("--dry-run", action="store_true",
+                   help="report changes, write nothing")
+    p.add_argument("--out", default=None,
+                   help="output path (default: the committed baseline)")
+    args = p.parse_args(argv)
+
+    notes = {}
+    for spec in args.justify:
+        key, sep, note = spec.partition("=")
+        if not sep or not note.strip() or "::" not in key:
+            p.error(f"--justify must be PROGRAM::METRIC=NOTE, got {spec!r}")
+        notes[key.strip()] = note.strip()
+
+    sys.path.insert(0, REPO)
+    _ensure_devices()
+    from implicitglobalgrid_tpu.analysis import costmodel
+    from implicitglobalgrid_tpu.analysis.core import Context
+
+    try:
+        census = costmodel.cost_census(Context())
+    except Exception as e:  # noqa: BLE001 — CLI surface
+        print(f"refresh_cost_baseline: census failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    path = args.out or costmodel.COST_BASELINE
+    old = {"programs": {}, "tolerances": dict(costmodel.TOLERANCES)}
+    if os.path.exists(path):
+        old = costmodel.load_baseline(path)
+
+    changed, missing_notes = [], []
+    removal_notes = {}
+    programs = {}
+    for name in sorted(census):
+        metrics = {
+            m: (int(v) if float(v).is_integer() else round(float(v), 2))
+            for m, v in sorted(census[name].items())
+        }
+        old_prog = old.get("programs", {}).get(name, {})
+        old_metrics = old_prog.get("metrics", {})
+        old_just = old_prog.get("justifications", {})
+        justifications = {}
+        for m, v in metrics.items():
+            key = f"{name}::{m}"
+            if m in old_metrics and old_metrics[m] == v:
+                justifications[m] = old_just.get(
+                    m, notes.get(key, args.justify_all or "")
+                )
+            else:
+                was = old_metrics.get(m, "<absent>")
+                changed.append(f"{key}: {was} -> {v}")
+                note = notes.get(key, args.justify_all)
+                if not note:
+                    missing_notes.append(key)
+                justifications[m] = note or ""
+        for m in sorted(set(old_metrics) - set(metrics)):
+            # A baselined metric the census stopped producing is the gate
+            # LOSING a blind-spot check — dropping it must be as audited
+            # as changing it (the costmodel pass reports the same absence
+            # as `metric-lost` until the baseline is refreshed).
+            key = f"{name}::{m}"
+            changed.append(f"{key}: {old_metrics[m]} -> <removed>")
+            note = notes.get(key, args.justify_all)
+            if note:
+                removal_notes[key] = note
+            else:
+                missing_notes.append(key)
+        programs[name] = {"metrics": metrics,
+                          "justifications": justifications}
+    for name in sorted(set(old.get("programs", {})) - set(census)):
+        # A whole program leaving the matrix drops EVERY one of its gated
+        # metrics — the audit bar is the same as for a single metric
+        # (justify as `PROGRAM::*`).
+        changed.append(f"{name}: removed (no longer in the compiled matrix)")
+        note = notes.get(f"{name}::*", args.justify_all)
+        if note:
+            removal_notes[f"{name}::*"] = note
+        else:
+            missing_notes.append(f"{name}::*")
+
+    for line in changed:
+        print(f"changed  {line}")
+    if not changed:
+        print("refresh_cost_baseline: census matches the committed "
+              "baseline — nothing to refresh")
+    if args.dry_run:
+        return 0
+    if missing_notes:
+        print("refresh_cost_baseline: FAIL — changed metric(s) without a "
+              "--justify note:", file=sys.stderr)
+        for key in missing_notes:
+            print(f"  --justify \"{key}=<why>\"", file=sys.stderr)
+        return 1
+
+    data = {
+        "version": 1,
+        "tolerances": old.get("tolerances",
+                              dict(costmodel.TOLERANCES)),
+        "programs": programs,
+    }
+    # removals are an APPEND-ONLY audit log: the note explaining why a
+    # gated metric/program left the baseline must outlive the entry itself
+    removals = {**old.get("removals", {}), **removal_notes}
+    if removals:
+        data["removals"] = removals
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"refresh_cost_baseline: wrote {path} "
+          f"({len(programs)} program(s), {len(changed)} change(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -772,3 +772,476 @@ def test_budget_analyzer_fires_when_budget_tightened_to_zero():
     assert {f.symbol for f in found} == {
         "diffusion/dim0", "diffusion/dim1", "diffusion/dim2",
     }
+
+
+# -- hlo-cost -----------------------------------------------------------------
+
+
+class _CostCtx:
+    """Stub Context: a traced exchange entry + an HLO text, nothing else."""
+
+    def __init__(self, entries, hlo):
+        self._entries, self._hlo = entries, hlo
+
+    def exchange_entries(self):
+        return self._entries
+
+    def exchange_hlo(self):
+        return self._hlo
+
+
+def _traced_exchange_stub(payload_bytes_list):
+    from implicitglobalgrid_tpu.analysis.ir import (
+        EXCHANGE_HLO_PROGRAM,
+        CollectiveOp,
+    )
+
+    ops = [
+        CollectiveOp(kind="ppermute", axes=("x",), perm=((0, 1),),
+                     payload_bytes=b, shapes=(f"f32[{b // 4}]",), path=())
+        for b in payload_bytes_list
+    ]
+    stub = _StubEntry(ops)
+    stub.name = EXCHANGE_HLO_PROGRAM
+    return stub
+
+
+def test_cost_text_census_counts_the_hlo_structure():
+    from implicitglobalgrid_tpu.analysis.costmodel import text_census
+
+    c = text_census(_hlo_fixture(6))
+    assert c["collective_permutes"] == 6
+    assert c["collective_payload_bytes"] == 6 * 144  # f32[6,6] per hop
+    assert c["payload_fallbacks"] == 0
+    assert c["fusions"] == 0 and c["kernel_launches"] == 0
+
+
+def test_payload_crosscheck_byte_exact_and_fires_on_mismatch():
+    from implicitglobalgrid_tpu.analysis.costmodel import (
+        payload_crosscheck_findings,
+    )
+
+    # byte-exact twin: 6 traced hops of 144 B vs 6 compiled permutes
+    clean = payload_crosscheck_findings(
+        _CostCtx([_traced_exchange_stub([144] * 6)], _hlo_fixture(6))
+    )
+    assert clean == []
+
+    # a widened hop (the seeded 2x payload regression) must fire
+    widened = payload_crosscheck_findings(
+        _CostCtx([_traced_exchange_stub([288] + [144] * 5), ],
+                 _hlo_fixture(6))
+    )
+    assert [f.code for f in widened] == ["payload-mismatch"]
+
+    # a lost hop fires too (count is part of the identity)
+    lost = payload_crosscheck_findings(
+        _CostCtx([_traced_exchange_stub([144] * 5)], _hlo_fixture(6))
+    )
+    assert [f.code for f in lost] == ["payload-mismatch"]
+
+    # a raw-sum fallback is its own failure, declared by the parser
+    fb = payload_crosscheck_findings(
+        _CostCtx([_traced_exchange_stub([144] * 5 + [240])],
+                 _hlo_fixture(5, bad_start=True))
+    )
+    assert "payload-fallback" in [f.code for f in fb]
+
+    # no traced twin at all = a broken cross-check, never a clean pass
+    gone = payload_crosscheck_findings(_CostCtx([], _hlo_fixture(6)))
+    assert [f.code for f in gone] == ["crosscheck-broken"]
+
+
+def _cost_baseline(metrics, tolerances=None):
+    return {
+        "version": 1,
+        "tolerances": tolerances or {"flops": 0.02, "*": 0.0},
+        "programs": {
+            "prog": {
+                "metrics": dict(metrics),
+                "justifications": {m: "pinned by fixture" for m in metrics},
+            }
+        },
+    }
+
+
+def test_compare_census_fires_on_inflated_payload_and_defused_kernel():
+    from implicitglobalgrid_tpu.analysis.costmodel import compare_census
+
+    base = _cost_baseline(
+        {"collective_payload_bytes": 8064, "kernel_launches": 3,
+         "flops": 1000}
+    )
+    clean = {"prog": {"collective_payload_bytes": 8064,
+                      "kernel_launches": 3, "flops": 1000}}
+    assert compare_census(clean, base) == []
+
+    # the seeded 2x payload inflation (acceptance fixture) fails the gate
+    doubled = {"prog": {"collective_payload_bytes": 16128,
+                        "kernel_launches": 3, "flops": 1000}}
+    found = compare_census(doubled, base)
+    assert [f.code for f in found] == ["cost-regression"]
+    assert found[0].anchor == "collective_payload_bytes"
+
+    # one defused extra kernel launch fails too (structural = exact band)
+    defused = {"prog": {"collective_payload_bytes": 8064,
+                        "kernel_launches": 4, "flops": 1000}}
+    found = compare_census(defused, base)
+    assert [f.code for f in found] == ["cost-regression"]
+    assert found[0].anchor == "kernel_launches"
+
+
+def test_compare_census_tolerance_bands_and_two_sided_drift():
+    from implicitglobalgrid_tpu.analysis.costmodel import compare_census
+
+    base = _cost_baseline({"flops": 1000, "kernel_launches": 3})
+    inside = {"prog": {"flops": 1010, "kernel_launches": 3}}  # +1% < 2%
+    assert compare_census(inside, base) == []
+    outside = {"prog": {"flops": 1030, "kernel_launches": 3}}  # +3% > 2%
+    assert [f.code for f in compare_census(outside, base)] == [
+        "cost-regression"
+    ]
+    # an IMPROVEMENT outside the band is news, not silent drift
+    better = {"prog": {"flops": 900, "kernel_launches": 3}}
+    found = compare_census(better, base)
+    assert [f.code for f in found] == ["cost-regression"]
+    assert "improved" in found[0].message
+
+
+def test_compare_census_reports_lost_and_unbaselined():
+    from implicitglobalgrid_tpu.analysis.costmodel import compare_census
+
+    base = _cost_baseline({"flops": 1000})
+    # the toolchain stopped reporting a gated metric: blind spot, ERROR
+    lost = compare_census({"prog": {"kernel_launches": 3}}, base)
+    codes = {f.code for f in lost}
+    assert "metric-lost" in codes and "metric-unbaselined" in codes
+    # a program disappearing from the matrix is an ERROR as well
+    assert [f.code for f in compare_census({}, base)] == ["program-missing"]
+    # a new program with no baseline entry is a WARNING nudge to refresh
+    extra = compare_census(
+        {"prog": {"flops": 1000}, "prog2": {"flops": 5}}, base
+    )
+    assert [f.code for f in extra] == ["program-unbaselined"]
+    assert extra[0].severity == "WARNING"
+
+
+def test_cost_baseline_loader_enforces_the_audit_contract(tmp_path):
+    from implicitglobalgrid_tpu.analysis import costmodel
+
+    p = tmp_path / "cost_baseline.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "programs": {"prog": {"metrics": {"flops": 1},
+                              "justifications": {"flops": "  "}}},
+    }))
+    with pytest.raises(ValueError, match="unjustified"):
+        costmodel.load_baseline(str(p))
+    p.write_text(json.dumps({"version": 99, "programs": {}}))
+    with pytest.raises(ValueError, match="version"):
+        costmodel.load_baseline(str(p))
+
+
+# -- grad-soundness -----------------------------------------------------------
+
+
+def test_dropper_scan_fires_on_bitcast_in_tangent_path():
+    """The seeded PR-5 class: a bitcast transport with NO custom VJP on the
+    differentiable path must be CRITICAL (jax.grad silently zeroes every
+    cotangent through it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.analysis.gradflow import dropper_findings
+
+    def broken(x):
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        return jax.lax.bitcast_convert_type(u, jnp.float32) * 2.0
+
+    jaxpr = jax.make_jaxpr(broken)(jnp.ones(4, jnp.float32))
+    found = dropper_findings(jaxpr.jaxpr, "fixture/broken")
+    assert [f.severity for f in found] == ["CRITICAL"]
+    assert found[0].code == "cotangent-dropper"
+    assert "bitcast_convert_type" in found[0].message
+    assert "_packed_transport" in found[0].fix_hint
+    # in-repo source locations are REPO-RELATIVE: the fingerprint hashes
+    # the path, so an absolute checkout prefix would pin baselines (and
+    # the SARIF artifact URIs) to one machine
+    assert found[0].path and not os.path.isabs(found[0].path)
+    assert found[0].path.startswith("tests/")
+
+
+def test_dropper_scan_fires_on_float_to_int_cast_and_warns_stop_gradient():
+    import jax
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.analysis.gradflow import dropper_findings
+
+    def int_cast(x):
+        return x.astype(jnp.int32).astype(jnp.float32) * 2.0
+
+    jaxpr = jax.make_jaxpr(int_cast)(jnp.ones(4, jnp.float32))
+    found = dropper_findings(jaxpr.jaxpr, "fixture/cast")
+    assert [f.severity for f in found] == ["CRITICAL"]
+
+    def stopped(x):
+        return jax.lax.stop_gradient(x) * 2.0
+
+    jaxpr = jax.make_jaxpr(stopped)(jnp.ones(4, jnp.float32))
+    found = dropper_findings(jaxpr.jaxpr, "fixture/stop")
+    assert [f.severity for f in found] == ["WARNING"]
+
+
+def test_dropper_scan_quiet_off_the_tangent_path_and_under_custom_vjp():
+    import jax
+    import jax.numpy as jnp
+
+    from implicitglobalgrid_tpu.analysis.gradflow import dropper_findings
+
+    # bitcast feeding only a side computation that never reaches the
+    # outputs' dataflow from the float inputs: int operand = not tainted
+    def side(x, idx):
+        shifted = jax.lax.bitcast_convert_type(idx, jnp.int32)
+        return x * 2.0, shifted
+
+    jaxpr = jax.make_jaxpr(side)(
+        jnp.ones(4, jnp.float32), jnp.ones(4, jnp.uint32)
+    )
+    assert dropper_findings(jaxpr.jaxpr, "fixture/side") == []
+
+    # the registered-VJP envelope is the documented fix and is exempt
+    @jax.custom_vjp
+    def packed(x):
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+    packed.defvjp(lambda x: (packed(x), None), lambda _, g: (g,))
+
+    def wrapped(x):
+        return packed(x) * 2.0
+
+    jaxpr = jax.make_jaxpr(wrapped)(jnp.ones(4, jnp.float32))
+    assert dropper_findings(jaxpr.jaxpr, "fixture/protected") == []
+
+
+def test_real_packed_transport_runs_clean_and_exemption_is_alive():
+    """The negative fixture of the ISSUE: the coalesced exchange's
+    `_packed_transport` (registered VJP) scans clean — and the control
+    proves the custom-vjp exemption is what keeps it clean (the bitcast
+    transport IS there underneath)."""
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.analysis import gradflow, ir
+    from implicitglobalgrid_tpu.ops import halo
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, periodz=1,
+                         quiet=True)
+    try:
+        gg = igg.get_global_grid()
+        fields = ir.model_field_structs("porous", 8)
+
+        def body(*fs):
+            return halo.exchange_dims_multi(fs, (0, 1, 2), width=1,
+                                            coalesce=True)
+
+        jaxpr = ir.unwrap_inner(ir._trace_mapped(body, fields, gg).jaxpr)
+    finally:
+        igg.finalize_global_grid()
+
+    assert gradflow.dropper_findings(jaxpr, "exchange/porous") == []
+
+    # liveness control: descending past the protection must surface the
+    # packed transport's bitcasts — the exemption does real work
+    import pytest as _pytest
+
+    mp = _pytest.MonkeyPatch()
+    try:
+        mp.setattr(gradflow, "_PROTECTED", ())
+        unprotected = gradflow.dropper_findings(jaxpr, "exchange/porous")
+    finally:
+        mp.undo()
+    assert any(
+        f.code == "cotangent-dropper" and "bitcast" in f.anchor
+        for f in unprotected
+    )
+
+
+class _StubGrad:
+    def __init__(self, name, grad_n, primal_n):
+        self.name = name
+        self._counts = (grad_n, primal_n)
+
+    def collective_counts(self):
+        return self._counts
+
+
+def test_backward_collective_census_separates_healthy_from_sunk():
+    from implicitglobalgrid_tpu.analysis.gradflow import census_findings
+
+    # healthy: VJP issues strictly more collectives than the primal
+    assert census_findings([_StubGrad("grad/x", 66, 6)]) == []
+
+    # the PR-5 failure shape: VJP count == primal count (no backward hops)
+    sunk = census_findings([_StubGrad("grad/x", 5, 5)])
+    assert [f.code for f in sunk] == ["cotangent-sink"]
+    assert sunk[0].severity == "CRITICAL"
+
+    # a primal with zero collectives means the census itself went blind
+    blind = census_findings([_StubGrad("grad/x", 3, 0)])
+    assert [f.code for f in blind] == ["census-broken"]
+
+
+# -- changed-files ref mode (--changed-only=REF) ------------------------------
+
+
+def _git_fixture_repo(tmp_path):
+    import subprocess
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True)
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo, capture_output=True,
+            text=True,
+        ).stdout.strip()
+
+    git("init", "-q")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    (repo / "base.txt").write_text("base\n")
+    git("add", "base.txt")
+    base_sha = git("commit", "-qm", "base")
+    git("checkout", "-qb", "feature")
+    (repo / "feat.txt").write_text("feat\n")
+    git("add", "feat.txt")
+    git("commit", "-qm", "feat")
+    return repo, base_sha
+
+
+def test_changed_files_ref_mode_sees_committed_diffs(tmp_path):
+    """On a CLEAN checkout `git status` selects nothing — the CI hole the
+    satellite fixes; ref mode diffs against the merge-base instead, and the
+    two censuses union when the worktree is dirty too."""
+    from implicitglobalgrid_tpu.analysis.core import changed_files
+
+    repo, base_sha = _git_fixture_repo(tmp_path)
+
+    assert changed_files(str(repo)) == []  # clean checkout: status empty
+    assert changed_files(str(repo), ref=base_sha) == ["feat.txt"]
+
+    (repo / "dirty.txt").write_text("wip\n")  # untracked joins the union
+    got = changed_files(str(repo), ref=base_sha)
+    assert set(got) == {"feat.txt", "dirty.txt"}
+    assert changed_files(str(repo)) == ["dirty.txt"]  # status mode unchanged
+
+
+def test_changed_files_ref_mode_raises_on_bad_ref(tmp_path):
+    """A bad ref must RAISE, not silently select zero analyzers — an empty
+    census would green-light a PR that was never linted."""
+    from implicitglobalgrid_tpu.analysis.core import changed_files
+
+    repo, _ = _git_fixture_repo(tmp_path)
+    with pytest.raises(RuntimeError, match="merge-base"):
+        changed_files(str(repo), ref="no-such-ref-xyz")
+
+
+# -- SARIF export -------------------------------------------------------------
+
+
+def _sarif_fixture_report():
+    from implicitglobalgrid_tpu.analysis.core import Finding, Report
+
+    dropper = Finding(
+        analyzer="grad-soundness", code="cotangent-dropper",
+        severity="CRITICAL",
+        message="fixture: bitcast on the tangent path",
+        path="implicitglobalgrid_tpu/ops/halo.py", line=12,
+        symbol="exchange/porous", anchor="bitcast[f32]",
+        fix_hint="wrap the transport in jax.custom_vjp",
+    )
+    cost = Finding(
+        analyzer="hlo-cost", code="cost-regression", severity="ERROR",
+        message="fixture: payload bytes doubled",
+        symbol="exchange/porous[coalesce=True]",
+        anchor="collective_payload_bytes",
+    )
+    suppressed = Finding(
+        analyzer="knob-binding", code="env-read-in-trace",
+        severity="WARNING", message="fixture: traced knob read",
+        path="implicitglobalgrid_tpu/ops/halo.py", symbol="f",
+        anchor="IGG_FIXTURE",
+    )
+    return Report(
+        findings=[cost, dropper],
+        suppressed=[(suppressed, "documented per-call contract")],
+        ran=["grad-soundness", "hlo-cost", "knob-binding"],
+        skipped=["knob-decl"],
+    )
+
+
+def test_sarif_export_matches_the_golden_file():
+    """The full artifact is pinned byte-for-byte (sorted keys, stable
+    ordering, no timestamps) — CI consumers parse this exact shape, so any
+    schema drift must be a reviewed diff of the golden file."""
+    from implicitglobalgrid_tpu.analysis.sarif import report_to_sarif
+
+    golden_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "data",
+        "igg_lint_golden.sarif",
+    )
+    got = json.dumps(report_to_sarif(_sarif_fixture_report()), indent=2,
+                     sort_keys=True) + "\n"
+    with open(golden_path, encoding="utf-8") as f:
+        assert got == f.read()
+
+
+def test_sarif_results_carry_fingerprints_and_suppressions():
+    from implicitglobalgrid_tpu.analysis.sarif import report_to_sarif
+
+    report = _sarif_fixture_report()
+    sarif = report_to_sarif(report)
+    run0 = sarif["runs"][0]
+    assert sarif["version"] == "2.1.0"
+    assert run0["tool"]["driver"]["name"] == "igg-lint"
+
+    results = run0["results"]
+    assert len(results) == 3  # 2 active + 1 suppressed
+    fps = {f.fingerprint for f in report.findings} | {
+        f.fingerprint for f, _ in report.suppressed
+    }
+    assert {
+        r["partialFingerprints"]["iggLintFingerprint/v1"] for r in results
+    } == fps
+    sup = [r for r in results if "suppressions" in r]
+    assert len(sup) == 1
+    assert sup[0]["suppressions"][0]["justification"] == (
+        "documented per-call contract"
+    )
+    # CRITICAL maps to SARIF "error" but keeps its name in properties
+    crit = next(r for r in results
+                if r["ruleId"] == "grad-soundness/cotangent-dropper")
+    assert crit["level"] == "error"
+    assert crit["properties"]["iggSeverity"] == "CRITICAL"
+    assert crit["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 12
+
+
+def test_sarif_rule_level_is_worst_severity_regardless_of_order():
+    """A rule spanning severities (cotangent-dropper: CRITICAL bitcast vs
+    WARNING stop_gradient) must advertise its WORST case even when a
+    milder finding appears first — rule metadata must not flip with
+    finding order."""
+    from implicitglobalgrid_tpu.analysis.core import Finding, Report
+    from implicitglobalgrid_tpu.analysis.sarif import report_to_sarif
+
+    def f(sev, anchor):
+        return Finding(analyzer="grad-soundness", code="cotangent-dropper",
+                       severity=sev, message="m", symbol="s", anchor=anchor)
+
+    report = Report(findings=[f("WARNING", "stop_gradient"),
+                              f("CRITICAL", "bitcast")],
+                    ran=["grad-soundness"])
+    rule = report_to_sarif(report)["runs"][0]["tool"]["driver"]["rules"][0]
+    assert rule["defaultConfiguration"]["level"] == "error"
