@@ -52,6 +52,105 @@ _bench = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_bench)
 
 
+def _cpu_mesh_json(args, timeout=1800):
+    # Shared subprocess driver for records defined on the virtual
+    # 8-device CPU mesh (the TPU backend is already initialized in this
+    # process; one core timeshares all 8 "devices" there, so wall times
+    # from these runs are code-path records, not performance numbers).
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_here, env.get("PYTHONPATH")) if p
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(_here, "benchmarks", "run.py"),
+         *args],
+        capture_output=True, text=True, env=env, timeout=timeout,
+    )
+    rec = None
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # brace-prefixed non-JSON noise
+    if rec is None:
+        raise RuntimeError(
+            f"{args[0]} run produced no JSON (rc={out.returncode}): "
+            f"{out.stderr[-400:]}"
+        )
+    return rec
+
+
+def _batch_extra(rec=None):
+    # ISSUE 8: the ensemble-batching record — members/s/chip over a
+    # B∈{1,2,4,8} sweep of the vmapped serving cadence.  Every sweep row's
+    # ``members_per_s`` is a gated metric (analysis.perf.GATED_KEYS), so a
+    # batching regression fails the bench-regression pass like a bandwidth
+    # drop.  ``rec``: a pre-measured `bench_batch` record (main_batch) —
+    # one projection of the record, however it was obtained.
+    r = rec if rec is not None else _bench.bench_batch(
+        n=128, chunk=16, reps=3, emit=False
+    )
+    return {
+        "members_per_s": r["members_per_s"],
+        "best_B": r["best_B"],
+        "job_steps": r["job_steps"],
+        "throughput_multiplier": r["throughput_multiplier"],
+        "sweep": r["sweep"],
+    }
+
+
+def _batch_hlo_extra():
+    # The structural half of the batching claim: the B=8 coalesced
+    # exchange's compiled HLO must emit EXACTLY the B=1 collective count
+    # (payload ×8).  Virtual-mesh record (see _cpu_mesh_json).
+    rec = _cpu_mesh_json(["batch_hlo"])
+    rec["note"] = (
+        "virtual 8-device CPU mesh: structural collective-count A/B; "
+        "equality is the B-for-the-price-of-1 invariant"
+    )
+    return rec
+
+
+def main_batch():
+    """``python bench.py batch`` — the focused ensemble-serving record:
+    one JSON line with the members/s/chip sweep, the HLO collective A/B
+    and its own perf-gate verdict."""
+    extras = {}
+    rec = _bench.bench_batch(n=128, chunk=16, reps=3, emit=False)
+    extras["batch_ensemble"] = _batch_extra(rec)
+    try:
+        extras["batch_hlo_ab"] = _batch_hlo_extra()
+    except Exception as e:  # structural A/B must not sink the record
+        extras["batch_hlo_ab"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        from implicitglobalgrid_tpu.analysis.perf import gate_summary
+
+        # No "value" key on purpose: this record's headline is members/s,
+        # the committed rounds' is GB/s — only the namespaced
+        # ``members_per_s`` extras are comparable across rounds.
+        extras["perf_gate"] = gate_summary({"extras": extras}, _here)
+    except Exception as e:
+        extras["perf_gate"] = {"error": f"{type(e).__name__}: {e}"}
+    print(
+        json.dumps(
+            {
+                "metric": "diffusion3d_batch_members_per_s",
+                "value": rec["members_per_s"],
+                "unit": "members/s/chip",
+                "extras": extras,
+            }
+        )
+    )
+
+
 def main():
     # Headline: the faster of the two production paths at the headline config
     # (metric name unchanged from round 1 for comparability).  The XLA path
@@ -282,41 +381,6 @@ def main():
     _extra("acoustic_periodxz_pipelined_ab", lambda: _acoustic_ab("xz"))
     _extra("porous_periodxz_pipelined_ab", lambda: _porous_ab("xz"))
 
-    def _cpu_mesh_json(args, timeout=1800):
-        # Shared subprocess driver for records defined on the virtual
-        # 8-device CPU mesh (the TPU backend is already initialized in this
-        # process; one core timeshares all 8 "devices" there, so wall times
-        # from these runs are code-path records, not performance numbers).
-        import subprocess
-        import sys
-
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in (_here, env.get("PYTHONPATH")) if p
-        )
-        out = subprocess.run(
-            [sys.executable, os.path.join(_here, "benchmarks", "run.py"),
-             *args],
-            capture_output=True, text=True, env=env, timeout=timeout,
-        )
-        rec = None
-        for line in out.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # brace-prefixed non-JSON noise
-        if rec is None:
-            raise RuntimeError(
-                f"{args[0]} run produced no JSON (rc={out.returncode}): "
-                f"{out.stderr[-400:]}"
-            )
-        return rec
-
     def _halo_coalesce_ab():
         # ISSUE 5 acceptance: the coalesced-vs-per-field A/B with collective
         # counts + payload bytes read from each variant's optimized HLO.  On
@@ -380,6 +444,10 @@ def main():
     _extra("weak_scaling_codepath", _weak_codepath)
     _extra("weak_scaling_aot_proxy_256chip", _weak_aot_proxy)
     _extra("weak_scaling_aot_proxy_256chip_pipelined", _weak_aot_proxy_pipelined)
+    # ISSUE 8: ensemble batching — members/s/chip B-sweep (gated metrics)
+    # + the B=8-vs-B=1 compiled collective-count A/B.
+    _extra("batch_ensemble", _batch_extra)
+    _extra("batch_hlo_ab", _batch_hlo_extra)
     # The observability surface is the record of record now: every bench
     # above folded its measurement into the process registry (`_emit`), so
     # the snapshot ships in the artifact instead of a private tally
@@ -435,4 +503,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "batch":
+        main_batch()
+    elif len(sys.argv) > 1:
+        raise SystemExit(
+            f"unknown mode {sys.argv[1]!r}: bench.py [batch] (no argument "
+            f"= the full headline record)"
+        )
+    else:
+        main()

@@ -410,6 +410,113 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     )
 
 
+#: Standard member job length (steps) the members/s/chip figure normalizes
+#: to: members_per_s = B / (t_step * BATCH_JOB_STEPS) / nchips — a
+#: completed-standard-jobs-per-second rate, so the sweep is comparable
+#: across rounds whatever chunk the timing used.
+BATCH_JOB_STEPS = 100
+
+
+def bench_batch(n=128, chunk=16, reps=3, dtype="float32", B_list=(1, 2, 4, 8),
+                emit=True, fused_k=None, fused_tile=None, exchange_every=1,
+                overlap=None, period=None):
+    """Batched ensemble serving throughput (ISSUE 8): members/s/chip over a
+    B sweep of the vmapped diffusion cadence (`make_multi_step(batch=True)`,
+    the `serving.ServingLoop` round step).
+
+    The claim under test: batching is a near-free throughput multiplier —
+    B members cost ONE collective pair per exchanged dimension (see the
+    ``batch_hlo`` A/B for the structural proof), so members/s/chip should
+    scale ~×B until the batch saturates HBM.  ``extras.sweep`` records one
+    row per B (each row's ``members_per_s`` is a gated perf metric,
+    `analysis.perf.GATED_KEYS`); the headline value is the best B's rate,
+    with ``throughput_multiplier`` = best/B1.
+    """
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import _batched, diffusion3d
+    from implicitglobalgrid_tpu.utils import telemetry as _telemetry
+
+    okw = _grid_kwargs(overlap, period)
+    sweep = {}
+    nprocs = 1
+    for B in B_list:
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
+        bstate, params = _batched.batched_setup(
+            diffusion3d, n, n, n, batch=B,
+            dtype=jax.numpy.dtype(dtype), quiet=True, **okw,
+        )
+        step = diffusion3d.make_multi_step(
+            params, chunk, donate=False, batch=True, fused_k=fused_k,
+            fused_tile=fused_tile, exchange_every=exchange_every,
+        )
+        t_it, _state, spread = _time_steps(step, bstate, chunk, reps)
+        gg = igg.get_global_grid()
+        nprocs = gg.nprocs
+        igg.finalize_global_grid()
+        members_per_s = B / (t_it * BATCH_JOB_STEPS) / nprocs
+        sweep[f"B{B}"] = {
+            "members_per_s": round(members_per_s, 4),
+            "member_steps_per_s": round(B / t_it / nprocs, 2),
+            "t_step_ms": round(t_it * 1e3, 4),
+            "spread": spread,
+        }
+        _telemetry.gauge(f"bench.batch.B{B}.members_per_s").set(
+            members_per_s
+        )
+    b1 = sweep.get("B1", {}).get("members_per_s") or None
+    best_key = max(sweep, key=lambda k: sweep[k]["members_per_s"])
+    best = sweep[best_key]["members_per_s"]
+    rec = {
+        "metric": f"diffusion3d_batch_{n}_{dtype}",
+        "value": best,
+        "unit": "members/s/chip",
+        "members_per_s": best,
+        "best_B": int(best_key[1:]),
+        "job_steps": BATCH_JOB_STEPS,
+        "nprocs": nprocs,
+        "sweep": sweep,
+        "throughput_multiplier": round(best / b1, 3) if b1 else None,
+    }
+    if emit:
+        print(json.dumps(rec), flush=True)
+    return rec
+
+
+def batch_hlo_ab(B=8, emit=True):
+    """The batched exchange's compiled-HLO collective A/B (ISSUE 8
+    acceptance): the B-member coalesced exchange must emit EXACTLY the
+    unbatched program's collective-permute count, with payload bytes ×B.
+    Structural (XLA:CPU 8-device mesh) — run it from any backend via the
+    subprocess driver (`bench.py`'s `_cpu_mesh_json`)."""
+    from implicitglobalgrid_tpu.analysis import ir
+    from implicitglobalgrid_tpu.analysis.costmodel import text_census
+
+    c1 = text_census(ir.compile_program(ir.EXCHANGE_HLO_PROGRAM).text)
+    cB = text_census(ir._compile_batched_exchange_program(B=B).text)
+    rec = {
+        "metric": "batch_hlo_collectives_ab",
+        "B": B,
+        "b1_collective_permutes": c1["collective_permutes"],
+        "bB_collective_permutes": cB["collective_permutes"],
+        "collectives_equal": (
+            c1["collective_permutes"] == cB["collective_permutes"]
+        ),
+        "b1_payload_bytes": c1["collective_payload_bytes"],
+        "bB_payload_bytes": cB["collective_payload_bytes"],
+        "payload_ratio": round(
+            cB["collective_payload_bytes"]
+            / max(c1["collective_payload_bytes"], 1),
+            3,
+        ),
+    }
+    if emit:
+        print(json.dumps(rec), flush=True)
+    return rec
+
+
 def bench_halo_coalesce(n=32, width=2, reps=3, emit=True):
     """Coalesced-vs-per-field exchange A/B (ISSUE 5) on the porous 5-field
     shape set, with collective counts and per-hop payload bytes read from
@@ -684,7 +791,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("what", nargs="?", default="all",
                    choices=["diffusion", "acoustic", "porous", "weak",
-                            "coalesce", "grad", "all"])
+                            "coalesce", "grad", "batch", "batch_hlo",
+                            "all"])
+    p.add_argument("--batch-sizes", default="1,2,4,8",
+                   help="comma-separated B sweep for the batch mode")
     p.add_argument("--n", type=int, default=None)
     p.add_argument("--chunk", type=int, default=25)
     p.add_argument("--reps", type=int, default=4)
@@ -739,6 +849,15 @@ def main():
                            model=a.weak_model, npt=a.npt)
     if a.what == "coalesce":
         bench_halo_coalesce(n=a.n or 32, reps=a.reps)
+    if a.what == "batch":
+        bench_batch(
+            n=a.n or 128, chunk=a.chunk, reps=a.reps, dtype=a.dtype,
+            B_list=tuple(int(b) for b in a.batch_sizes.split(",")),
+            fused_k=a.fused_k, exchange_every=a.exchange_every,
+            overlap=a.overlap, period=a.period,
+        )
+    if a.what == "batch_hlo":
+        batch_hlo_ab()
     if a.what == "grad":
         bench_diffusion_grad(n=a.n or 256, chunk=a.chunk, reps=a.reps,
                              dtype=a.dtype, fused_k=a.fused_k or 4,

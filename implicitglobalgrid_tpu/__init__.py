@@ -152,4 +152,16 @@ __all__ = [
     "dump_metrics",
     # static-analysis subsystem (docs/static-analysis.md)
     "analysis",
+    # batched multi-simulation serving (ISSUE 8; docs/api.md)
+    "serving",
 ]
+
+
+def __getattr__(name):
+    # Lazy: the serving subsystem pulls the model zoo in; importing igg
+    # itself must stay light (mirrors `models.__getattr__`).
+    if name == "serving":
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

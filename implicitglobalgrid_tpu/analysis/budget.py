@@ -213,6 +213,133 @@ def entry_budget_findings(entries, budget_pairs=None) -> list[Finding]:
     return out
 
 
+# -- batched-exchange census (ISSUE 8: B for the price of 1) ------------------
+
+#: Ensemble size the batched census traces alongside the unbatched program.
+BATCHED_CENSUS_B = 4
+
+
+def _exchange_axis_counts(fields, B: int | None) -> dict:
+    """Per-mesh-axis ppermute counts of the coalesced 3-dim exchange of
+    ``fields`` — traced unbatched (``B=None``) or under a vmapped leading
+    ensemble axis of size ``B`` (the `models._batched` layout)."""
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from .. import AXIS_NAMES
+    from ..ops.halo import exchange_dims_multi
+    from .ir import _trace_mapped, collect_collectives, unwrap_inner
+
+    gg = igg.get_global_grid()
+
+    def single(*fs):
+        return exchange_dims_multi(fs, (0, 1, 2), width=1, coalesce=True)
+
+    if B is None:
+        body, args = single, fields
+    else:
+        def body(*fs):
+            return jax.vmap(single)(*fs)
+
+        args = [
+            jax.ShapeDtypeStruct((B,) + tuple(f.shape), f.dtype)
+            for f in fields
+        ]
+    jaxpr = unwrap_inner(_trace_mapped(body, args, gg).jaxpr)
+    counts = {a: 0 for a in AXIS_NAMES}
+    for op in collect_collectives(jaxpr):
+        if op.kind == "ppermute" and op.axes:
+            counts[op.axes[0]] = counts.get(op.axes[0], 0) + 1
+    return counts
+
+
+def batched_exchange_census(n: int = 8, B: int = BATCHED_CENSUS_B,
+                            models=None) -> dict:
+    """``{model: {1: {axis: count}, B: {axis: count}}}`` over the coalesced
+    production exchange — the evidence behind the "B for the price of 1"
+    claim: the vmapped ensemble exchange must issue exactly the collective
+    counts of the unbatched one (the ppermute batching rule carries the
+    ensemble axis inside the SAME hop; payload bytes scale ×B instead).
+
+    Same grid as `budget_findings` (dims (2,2,2), periodic z: PROC_NULL and
+    periodic transports both live).  Trace-only — cheap enough for tier-1.
+    """
+    import implicitglobalgrid_tpu as igg
+
+    models = tuple(BUDGET_PAIRS) if models is None else tuple(models)
+    census: dict = {}
+    igg.init_global_grid(n, n, n, dimx=2, dimy=2, dimz=2, periodz=1,
+                         quiet=True)
+    try:
+        for model in models:
+            fields = model_field_structs(model, n)
+            census[model] = {
+                1: _exchange_axis_counts(fields, None),
+                B: _exchange_axis_counts(fields, B),
+            }
+    finally:
+        igg.finalize_global_grid()
+    return census
+
+
+def batched_census_findings(census: dict) -> list[Finding]:
+    """Findings over a batched-exchange census (pure — fixture-testable).
+
+    The invariant: for every model, every batched variant's per-dimension
+    ppermute counts EQUAL the unbatched baseline's.  A mismatch means the
+    ensemble axis re-serialized into per-member collectives (vmap fell
+    back to a loop, or a batching rule split the hop) — the exact
+    regression that would silently multiply fabric traffic by B.
+    """
+    out = []
+    for model, variants in sorted(census.items()):
+        base = variants.get(1)
+        if not base or all(v == 0 for v in base.values()):
+            out.append(
+                Finding(
+                    analyzer=ANALYZER,
+                    code="census-broken",
+                    severity="ERROR",
+                    message=(
+                        f"{model}: the batched-exchange census counted no "
+                        f"collectives in the unbatched baseline — the "
+                        f"ppermute census is not seeing the exchange."
+                    ),
+                    symbol=f"{model}/batched",
+                    anchor="baseline",
+                )
+            )
+            continue
+        for b, counts in sorted(variants.items()):
+            if b == 1:
+                continue
+            if counts != base:
+                out.append(
+                    Finding(
+                        analyzer=ANALYZER,
+                        code="batched-budget-mismatch",
+                        severity="ERROR",
+                        message=(
+                            f"{model}: the B={b} ensemble exchange emits "
+                            f"{counts} collective-permutes per dimension vs "
+                            f"{base} at B=1 — batching must ride the SAME "
+                            f"collectives (payload ×B), not issue more; the "
+                            f"vmapped exchange re-serialized per member."
+                        ),
+                        symbol=f"{model}/batch{b}",
+                        anchor=str(sorted(counts.items())),
+                    )
+                )
+    return out
+
+
+def batched_budget_findings(n: int = 8, B: int = BATCHED_CENSUS_B,
+                            models=None) -> list[Finding]:
+    """The batched-exchange census as tier-1 findings (empty = the
+    B-for-the-price-of-1 invariant holds for every model)."""
+    return batched_census_findings(batched_exchange_census(n, B, models))
+
+
 def hlo_budget_findings(txt: str, *, model: str = "porous",
                         pairs: int | None = None,
                         active_dims: int = 3) -> list[Finding]:
@@ -288,6 +415,8 @@ def hlo_budget_findings(txt: str, *, model: str = "porous",
 
 
 def run(ctx: Context) -> list[Finding]:
-    return entry_budget_findings(ctx.exchange_entries()) + hlo_budget_findings(
-        ctx.exchange_hlo()
+    return (
+        entry_budget_findings(ctx.exchange_entries())
+        + hlo_budget_findings(ctx.exchange_hlo())
+        + batched_census_findings(ctx.batched_exchange_census())
     )

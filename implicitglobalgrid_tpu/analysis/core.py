@@ -299,6 +299,7 @@ class Context:
         self._cadence = None
         self._grad = None
         self._compiled = {}
+        self._batched_census = None
 
     # AST IR ------------------------------------------------------------
 
@@ -336,6 +337,15 @@ class Context:
 
             self._exchange = ir.trace_exchange_entries()
         return self._exchange
+
+    def batched_exchange_census(self):
+        """The batched-exchange ppermute census (3 models x B∈{1, 4}),
+        traced once per context like the other IRs (`analysis.budget`)."""
+        if self._batched_census is None:
+            from . import budget
+
+            self._batched_census = budget.batched_exchange_census()
+        return self._batched_census
 
     def cadence_entries(self):
         """Traced model multi-step cadences (3 models x pipelined on/off)."""

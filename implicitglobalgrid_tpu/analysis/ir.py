@@ -224,28 +224,39 @@ def _trace_mapped(body, fields, gg, out_fields=None):
     ``out_fields`` overrides the output structure when it differs from the
     inputs (a traced VJP takes seeds + primals but returns one cotangent
     per primal — `trace_grad_entries`); default: outputs mirror inputs.
+
+    Fields of rank > NDIMS carry a leading BATCH/ensemble axis
+    (`models._batched` layout): the batch axis stays replicated
+    (``P(None, 'x', 'y', 'z')``) and is not multiplied by the mesh dims —
+    the tracing convention the batched-exchange census relies on.
     """
     import jax
     from jax.sharding import PartitionSpec as P
 
-    from .. import AXIS_NAMES
+    from .. import AXIS_NAMES, NDIMS
     from ..utils.compat import shard_map
 
-    specs = tuple(P(*AXIS_NAMES[: f.ndim]) for f in fields)
+    def spec(f):
+        nbatch = max(f.ndim - NDIMS, 0)
+        return P(*(None,) * nbatch, *AXIS_NAMES[: f.ndim - nbatch])
+
+    specs = tuple(spec(f) for f in fields)
     out_specs = (
-        specs
-        if out_fields is None
-        else tuple(P(*AXIS_NAMES[: f.ndim]) for f in out_fields)
+        specs if out_fields is None else tuple(spec(f) for f in out_fields)
     )
     mapped = shard_map(
         body, mesh=gg.mesh, in_specs=specs, out_specs=out_specs,
         check_vma=False,
     )
-    gargs = tuple(
-        jax.ShapeDtypeStruct(
-            tuple(s * gg.dims[i] for i, s in enumerate(f.shape)), f.dtype
+
+    def gshape(f):
+        nbatch = max(f.ndim - NDIMS, 0)
+        return f.shape[:nbatch] + tuple(
+            s * gg.dims[i] for i, s in enumerate(f.shape[nbatch:])
         )
-        for f in fields
+
+    gargs = tuple(
+        jax.ShapeDtypeStruct(gshape(f), f.dtype) for f in fields
     )
     return jax.make_jaxpr(mapped)(*gargs)
 
@@ -365,11 +376,20 @@ class CompiledProgram:
 #: the jaxpr and optimized-HLO IRs.  Cadences compile pipelined=True — the
 #: production schedule whose fusion/collective structure the baseline pins.
 EXCHANGE_HLO_PROGRAM = "exchange/porous[coalesce=True]"
+#: Ensemble size of the batched compiled programs (ISSUE 8): the batched
+#: exchange must keep the unbatched program's collective count with
+#: payload bytes scaled ×B — pinned by the cost baseline's
+#: ``collective_permutes`` / ``collective_payload_bytes`` metrics.
+BATCH_HLO_B = 4
+BATCHED_EXCHANGE_PROGRAM = f"exchange/porous[coalesce=True,batch={BATCH_HLO_B}]"
+BATCHED_CADENCE_PROGRAM = f"cadence/diffusion[batch={BATCH_HLO_B}]"
 COMPILED_MATRIX = (
     EXCHANGE_HLO_PROGRAM,
     "cadence/diffusion[pipelined=True]",
     "cadence/acoustic[pipelined=True]",
     "cadence/porous[pipelined=True]",
+    BATCHED_EXCHANGE_PROGRAM,
+    BATCHED_CADENCE_PROGRAM,
 )
 
 
@@ -405,6 +425,10 @@ def compile_program(name: str) -> CompiledProgram:
     """
     if name == EXCHANGE_HLO_PROGRAM:
         return _compile_exchange_program()
+    if name == BATCHED_EXCHANGE_PROGRAM:
+        return _compile_batched_exchange_program()
+    if name == BATCHED_CADENCE_PROGRAM:
+        return _compile_batched_cadence_program()
     for model in ("diffusion", "acoustic", "porous"):
         if name == f"cadence/{model}[pipelined=True]":
             return _compile_cadence_program(model)
@@ -465,6 +489,119 @@ def _compile_exchange_program(model: str = "porous", n: int = 8) -> CompiledProg
         )
     finally:
         igg.finalize_global_grid()
+
+
+def _compile_batched_exchange_program(model: str = "porous", n: int = 8,
+                                      B: int | None = None) -> CompiledProgram:
+    """The porous coalesced exchange under a vmapped B-member ensemble axis,
+    compiled — the optimized-HLO half of the B-for-the-price-of-1 evidence:
+    the cost baseline pins its ``collective_permutes`` EQUAL to the
+    unbatched twin's and its ``collective_payload_bytes`` at ×B."""
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from ..ops import halo
+
+    B = BATCH_HLO_B if B is None else B
+    igg.init_global_grid(n, n, n, dimx=2, dimy=2, dimz=2, periodz=1,
+                         quiet=True)
+    try:
+        gg = igg.get_global_grid()
+        fields = model_field_structs(model, n)
+
+        def single(*fs):
+            return halo.exchange_dims_multi(fs, (0, 1, 2), width=1,
+                                            coalesce=True)
+
+        def body(*fs):
+            return jax.vmap(single)(*fs)
+
+        from ..models._batched import _batched_spec
+        from ..utils.compat import shard_map
+
+        # THE batched-layout spec (`models._batched`): one definition for
+        # the traced census, the serving pool and these compiled programs.
+        specs = tuple(_batched_spec(f.ndim + 1) for f in fields)
+        mapped = shard_map(
+            body, mesh=gg.mesh, in_specs=specs, out_specs=specs,
+            check_vma=False,
+        )
+        gargs = tuple(
+            jax.ShapeDtypeStruct(
+                (B,) + tuple(s * gg.dims[i] for i, s in enumerate(f.shape)),
+                f.dtype,
+            )
+            for f in fields
+        )
+        compiled = jax.jit(mapped).lower(*gargs).compile()
+        memory, cost = _compiled_stats(compiled)
+        return CompiledProgram(
+            name=f"exchange/{model}[coalesce=True,batch={B}]",
+            kind="exchange",
+            config={"model": model, "n": n, "coalesce": True, "batch": B},
+            text=compiled.as_text(),
+            memory=memory,
+            cost=cost,
+        )
+    finally:
+        igg.finalize_global_grid()
+
+
+def _compile_batched_cadence_program(n: int = 8, B: int | None = None,
+                                     nt: int = 2) -> CompiledProgram:
+    """The batched diffusion serving cadence, compiled: ``make_multi_step(
+    exchange_every=2, batch=True)`` on a deep-halo 2-device grid — the
+    production shape of `serving.ServingLoop`'s round step (XLA cadence;
+    the fused kernels' batched structure is covered by the vmap census,
+    keeping this build seconds-cheap)."""
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from ..models import diffusion3d
+
+    import jax.numpy as jnp
+
+    B = BATCH_HLO_B if B is None else B
+    # setup OUTSIDE the try (like `_compile_exchange_program`): if a
+    # caller's grid is live, setup raises BEFORE the finally exists — the
+    # teardown must only ever finalize the grid THIS function created.
+    # dtype pinned like `_cadence_setup_kwargs`: the census must not
+    # depend on the process's x64 default.
+    state, params = diffusion3d.setup(
+        n, n, n, devices=jax.devices()[:2], dimx=2, dimy=1, dimz=1,
+        overlapx=4, overlapy=4, overlapz=4, quiet=True,
+        dtype=jnp.float32,
+    )
+    try:
+        from ..models._batched import stack_states
+
+        bstate = stack_states([state] * B)
+        step = diffusion3d.make_multi_step(
+            params, nt, donate=False, exchange_every=2, batch=True
+        )
+        gg = igg.get_global_grid()
+        from ..models._batched import _batched_spec
+        from ..utils.compat import shard_map
+
+        spec = _batched_spec(4)  # the one batched-layout definition
+        mapped = shard_map(
+            step.__wrapped__, mesh=gg.mesh,
+            in_specs=(spec,) * 2, out_specs=(spec,) * 2, check_vma=False,
+        )
+        compiled = jax.jit(mapped).lower(*bstate).compile()
+        memory, cost = _compiled_stats(compiled)
+        return CompiledProgram(
+            name=f"cadence/diffusion[batch={B}]",
+            kind="cadence",
+            config={"model": "diffusion", "n": n, "batch": B, "nt": nt,
+                    "exchange_every": 2},
+            text=compiled.as_text(),
+            memory=memory,
+            cost=cost,
+        )
+    finally:
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
 
 
 def compile_exchange_hlo(model: str = "porous", n: int = 8) -> str:

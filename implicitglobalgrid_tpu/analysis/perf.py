@@ -102,11 +102,19 @@ def load_bench_records(repo_root: str) -> tuple[list, list]:
     return records, skipped
 
 
+#: extras keys gated as higher-is-better throughput metrics.  ``teff`` /
+#: ``teff_grad`` are GB/s; ``members_per_s`` is the batched-serving
+#: members/s/chip record (``bench.py batch``, ISSUE 8) — same one-sided
+#: drop semantics, so a batching regression fails like a bandwidth one.
+GATED_KEYS = ("teff", "teff_grad", "members_per_s")
+
+
 def gate_metrics(record: dict) -> dict:
     """Flatten one bench record to ``{metric path: value}`` for the gated
-    throughput metrics (headline ``value`` + every nested ``teff``/
-    ``teff_grad`` under ``extras``; error-bearing extras contribute
-    nothing)."""
+    throughput metrics (headline ``value`` + every nested `GATED_KEYS`
+    entry under ``extras``; error-bearing extras contribute nothing —
+    wall-time columns drift with chip tenancy and are deliberately not
+    gated)."""
     out = {}
     if isinstance(record.get("value"), (int, float)):
         out["headline"] = float(record["value"])
@@ -115,7 +123,7 @@ def gate_metrics(record: dict) -> dict:
         if not isinstance(node, dict):
             return
         for key, val in node.items():
-            if key in ("teff", "teff_grad") and isinstance(val, (int, float)):
+            if key in GATED_KEYS and isinstance(val, (int, float)):
                 out[f"{prefix}{key}"] = float(val)
             elif isinstance(val, dict):
                 walk(f"{prefix}{key}.", val)

@@ -235,6 +235,14 @@ def fused_with_xla_grad(fused_body, xla_body):
     production path and the autodiff story (`tests/test_autodiff.py`)
     compose.  TPU-first capability — no reference analogue (the reference
     has no autodiff, SURVEY.md §0).
+
+    Composes with `jax.vmap` (the ensemble batch axis, `models._batched`):
+    custom_vjp has a batching rule, the Pallas chunk batches through the
+    `pallas_call` rule (batch as an outer grid dimension), and the XLA twin
+    vmaps like any jnp code — so `make_multi_step(batch=True)` keeps both
+    the fused primal and the differentiable story at any B
+    (`tests/test_batched_serving.py` pins the fused bit-identity per
+    member).
     """
     import jax
 

@@ -77,11 +77,14 @@ def setup(
     dtype=None,
     hide_comm: bool = False,
     init_grid: bool = True,
+    ic_scale: float = 1.0,
     **grid_kwargs,
 ):
     """Initialize grid + fields; a Gaussian pressure pulse at the domain center.
 
     Returns ``(state, params)`` with ``state = (P, Vx, Vy, Vz)``.
+    ``ic_scale`` scales the initial pressure pulse (the ensemble lever,
+    `models._batched.batched_setup`).
     """
     import jax
     import jax.numpy as jnp
@@ -112,7 +115,7 @@ def setup(
             - ((Y - ly / 2) / 1.0) ** 2
             - ((Z - lz / 2) / 1.0) ** 2
         )
-        return p0.astype(dtype)
+        return (ic_scale * p0).astype(dtype)
 
     P = init_ic(X, Y, Z)
     Vx = zeros((nx + 1, ny, nz), dtype)
@@ -157,8 +160,14 @@ def _pressure_update(params: Params):
     return update
 
 
-def make_step(params: Params, *, donate: bool = True):
-    """One fused SPMD leapfrog step: ``(P, Vx, Vy, Vz) -> (P, Vx, Vy, Vz)``."""
+def make_step(params: Params, *, donate: bool = True, batch: bool = False):
+    """One fused SPMD leapfrog step: ``(P, Vx, Vy, Vz) -> (P, Vx, Vy, Vz)``.
+
+    ``batch=True``: the ensemble step over ``(B, ...)`` batched fields —
+    `jax.vmap` of the same per-block step; bit-identical per member, one
+    collective pair per exchanged dimension at any B (see
+    `models.diffusion3d.make_step`).
+    """
     v_update = _velocity_update(params)
     p_update = _pressure_update(params)
 
@@ -179,6 +188,10 @@ def make_step(params: Params, *, donate: bool = True):
             return P, Vx, Vy, Vz
 
     donate_argnums = tuple(range(4)) if donate else ()
+    if batch:
+        from ._batched import batched_stencil
+
+        return batched_stencil(block_step, 4, donate_argnums=donate_argnums)
     return stencil(block_step, donate_argnums=donate_argnums)
 
 
@@ -197,7 +210,7 @@ def pipelined_support_error(shape, k, itemsize: int = 4, bx=None, by=None,
 def make_multi_step(
     params: Params, nsteps: int, *, donate: bool = True, exchange_every: int = 1,
     fused_k: int | None = None, fused_tile: tuple[int, int] | None = None,
-    pipelined: bool | None = None,
+    pipelined: bool | None = None, batch: bool = False,
 ):
     """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`).
 
@@ -229,8 +242,20 @@ def make_multi_step(
     dispatched off the ring pass, exactly as on
     `models.diffusion3d.make_multi_step` (bit-identical to the serialized
     schedule; auto when admissible, see `pipelined_support_error`).
+
+    ``batch``: vmap the whole cadence over a leading ensemble axis — every
+    path batches through the same vmap with a B-invariant collective
+    budget (see `models.diffusion3d.make_multi_step`).
     """
     from jax import lax
+
+    def _wrap(block_fn):
+        dn = tuple(range(4)) if donate else ()
+        if batch:
+            from ._batched import batched_stencil
+
+            return batched_stencil(block_fn, 4, donate_argnums=dn)
+        return stencil(block_fn, donate_argnums=dn)
 
     v_update = _velocity_update(params)
     p_update = _pressure_update(params)
@@ -368,9 +393,11 @@ def make_multi_step(
 
             # No halo activity = no collectives: plain jit on the grid's
             # single device (same rationale as the diffusion fused path).
+            body = lambda *s: fused_or_fallback(*s, fused_chunk, xla_chunk)
+            if batch:
+                body = jax.vmap(body)
             return jax.jit(
-                lambda *s: fused_or_fallback(*s, fused_chunk, xla_chunk),
-                donate_argnums=tuple(range(4)) if donate else (),
+                body, donate_argnums=tuple(range(4)) if donate else ()
             )
 
         def fused_block_step(P, Vx, Vy, Vz):
@@ -538,7 +565,7 @@ def make_multi_step(
 
             return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
 
-        return stencil(
+        return _wrap(
             lambda *s: fused_or_fallback(
                 *s, fused_block_step, xla_cadence_step, fused_zpatch_step,
                 pipelined_bodies={
@@ -546,8 +573,7 @@ def make_multi_step(
                     "zpatch": fused_zpatch_pipelined_step,
                     "xla": xla_pipelined_cadence_step,
                 },
-            ),
-            donate_argnums=tuple(range(4)) if donate else (),
+            )
         )
 
     if exchange_every < 1:
@@ -589,8 +615,7 @@ def make_multi_step(
 
             return lax.fori_loop(0, nsteps // w, group, (P, Vx, Vy, Vz))
 
-        donate_argnums = tuple(range(4)) if donate else ()
-        return stencil(block_step, donate_argnums=donate_argnums)
+        return _wrap(block_step)
 
     if pipelined:
         raise ValueError(
@@ -614,8 +639,7 @@ def make_multi_step(
 
         return lax.fori_loop(0, nsteps, body, (P, Vx, Vy, Vz))
 
-    donate_argnums = tuple(range(4)) if donate else ()
-    return stencil(block_step, donate_argnums=donate_argnums)
+    return _wrap(block_step)
 
 
 def run(

@@ -102,12 +102,16 @@ def setup(
     dtype=None,
     hide_comm: bool = False,
     init_grid: bool = True,
+    ic_scale: float = 1.0,
     **grid_kwargs,
 ):
     """Initialize the global grid (unless ``init_grid=False``) and the fields.
 
     Returns ``(state, params)`` where ``state = (T, Cp)`` are global-block
     fields with the reference's initial conditions (lines :34-37).
+    ``ic_scale`` scales the initial temperature anomaly — the ensemble
+    lever: `models._batched.batched_setup` gives each member a distinct
+    scale so batched members are distinct problems on one grid.
     """
     import jax
     import jax.numpy as jnp
@@ -130,7 +134,7 @@ def setup(
     @stencil
     def init_ic(X, Y, Z):
         cp, t = _gaussians(X, Y, Z, params, jnp)
-        return cp.astype(dtype), t.astype(dtype)
+        return cp.astype(dtype), (ic_scale * t).astype(dtype)
 
     Cp, T = init_ic(X, Y, Z)
     return (T, Cp), params
@@ -166,11 +170,17 @@ def _diffusion_update(params: Params):
     return update
 
 
-def make_step(params: Params, *, donate: bool = True):
+def make_step(params: Params, *, donate: bool = True, batch: bool = False):
     """Build the jitted SPMD time step: ``(T, Cp) -> (T, Cp)``.
 
     One call = one fused XLA program: stencil update + halo exchange
     (+ overlap scheduling when ``params.hide_comm``).
+
+    ``batch=True``: the ensemble step over ``(B, nx, ny, nz)`` batched
+    fields (`models._batched`) — `jax.vmap` of the same per-block step, so
+    B members advance bit-identically to B independent calls while every
+    exchanged dimension still issues ONE collective pair (the ppermute
+    batching rule carries the ensemble axis inside the same hop).
     """
     update = _diffusion_update(params)
 
@@ -187,6 +197,12 @@ def make_step(params: Params, *, donate: bool = True):
             T = update_halo(T)
             return T, Cp
 
+    if batch:
+        from ._batched import batched_stencil
+
+        return batched_stencil(
+            block_step, 2, donate_argnums=(0,) if donate else ()
+        )
     return stencil(block_step, donate_argnums=(0,) if donate else ())
 
 
@@ -213,6 +229,7 @@ def make_multi_step(
     fused_tile: tuple[int, int] | None = None,
     exchange_every: int = 1,
     pipelined: bool | None = None,
+    batch: bool = False,
 ):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
@@ -256,8 +273,23 @@ def make_multi_step(
     (`pipelined_support_error`).  ``pipelined=True`` also applies the
     early-dispatch exchange shape to the XLA cadences (the fused fallback
     and ``exchange_every``).
+
+    ``batch``: vmap the whole cadence over a leading ensemble axis (see
+    `make_step`).  Every path — fused Pallas chunks included (the
+    `pallas_call` batching rule adds the ensemble as an outer grid
+    dimension), slab exchanges, pipelined begin/finish — batches through
+    the same vmap, and the per-(dimension, width group) collective budget
+    is B-invariant (pinned by `analysis.budget.batched_budget_findings`).
     """
     from jax import lax
+
+    def _wrap(block_fn):
+        dn = (0,) if donate else ()
+        if batch:
+            from ._batched import batched_stencil
+
+            return batched_stencil(block_fn, 2, donate_argnums=dn)
+        return stencil(block_fn, donate_argnums=dn)
 
     if fused_k:
         from ..parallel.grid import global_grid
@@ -387,10 +419,10 @@ def make_multi_step(
             # No halo activity means no collectives: skip the shard_map
             # wrapper and jit directly (fields are committed to the grid's
             # single device).
-            return jax.jit(
-                lambda T, Cp: fused_or_fallback(T, Cp, fused_chunk, xla_chunk),
-                donate_argnums=(0,) if donate else (),
-            )
+            body = lambda T, Cp: fused_or_fallback(T, Cp, fused_chunk, xla_chunk)
+            if batch:
+                body = jax.vmap(body)
+            return jax.jit(body, donate_argnums=(0,) if donate else ())
 
         def fused_block_step(T, Cp):
             def body(ki, T):
@@ -559,7 +591,7 @@ def make_multi_step(
 
             return lax.fori_loop(0, nsteps // fused_k, group, T), Cp
 
-        return stencil(
+        return _wrap(
             lambda T, Cp: fused_or_fallback(
                 T, Cp, fused_block_step, xla_cadence_step, fused_zpatch_step,
                 pipelined_bodies={
@@ -567,8 +599,7 @@ def make_multi_step(
                     "zpatch": fused_zpatch_pipelined_step,
                     "xla": xla_pipelined_cadence_step,
                 },
-            ),
-            donate_argnums=(0,) if donate else (),
+            )
         )
 
     update = _diffusion_update(params)
@@ -610,7 +641,7 @@ def make_multi_step(
             T = lax.fori_loop(0, nsteps // w, group, T)
             return T, Cp
 
-        return stencil(block_step, donate_argnums=(0,) if donate else ())
+        return _wrap(block_step)
 
     if pipelined:
         raise ValueError(
@@ -633,7 +664,7 @@ def make_multi_step(
         T = lax.fori_loop(0, nsteps, lambda i, T: one(T, Cp), T)
         return T, Cp
 
-    return stencil(block_step, donate_argnums=(0,) if donate else ())
+    return _wrap(block_step)
 
 
 def run(

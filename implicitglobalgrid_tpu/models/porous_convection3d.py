@@ -84,11 +84,14 @@ def setup(
     dtype=None,
     hide_comm: bool = False,
     init_grid: bool = True,
+    ic_scale: float = 1.0,
     **grid_kwargs,
 ):
     """Grid + fields: linear conductive T profile with a central Gaussian
     perturbation (the standard porous-convection initial condition), zero
-    pressure and fluxes.  Returns ``(state, params)``."""
+    pressure and fluxes.  Returns ``(state, params)``.  ``ic_scale`` scales
+    the Gaussian perturbation (the ensemble lever,
+    `models._batched.batched_setup`)."""
     import jax
     import jax.numpy as jnp
 
@@ -138,7 +141,7 @@ def setup(
                 - ((Z - lz / 2) / 0.1) ** 2
             )
         )
-        return (prof + pert).astype(dtype)
+        return (prof + ic_scale * pert).astype(dtype)
 
     T = init_ic(X, Y, Z)
     Pf = zeros((nx, ny, nz), dtype)
@@ -299,10 +302,22 @@ def _build_block_step(params: Params):
     return block_step
 
 
-def make_step(params: Params, *, donate: bool = True):
+def make_step(params: Params, *, donate: bool = True, batch: bool = False):
     """One time step: ``npt`` PT pressure iterations (fori_loop) + T update,
-    compiled into one XLA program per block (see `_build_block_step`)."""
+    compiled into one XLA program per block (see `_build_block_step`).
+
+    ``batch=True``: the ensemble step over ``(B, ...)`` batched fields —
+    `jax.vmap` of the same per-block step; bit-identical per member, one
+    collective pair per exchanged dimension at any B (see
+    `models.diffusion3d.make_step`).
+    """
     donate_argnums = tuple(range(5)) if donate else ()
+    if batch:
+        from ._batched import batched_stencil
+
+        return batched_stencil(
+            _build_block_step(params), 5, donate_argnums=donate_argnums
+        )
     return stencil(_build_block_step(params), donate_argnums=donate_argnums)
 
 
@@ -360,6 +375,7 @@ def make_multi_step(
     fused_k: int | None = None,
     fused_tile: tuple[int, int] | None = None,
     pipelined: bool | None = None,
+    batch: bool = False,
 ):
     """Advance ``nsteps`` time steps per call in ONE XLA program
     (`lax.fori_loop` over whole time steps) — the production path: per-call
@@ -843,6 +859,14 @@ def make_multi_step(
         return s
 
     donate_argnums = tuple(range(5)) if donate else ()
+    if batch:
+        # Ensemble cadence: vmap over the leading member axis — every path
+        # (PT fori_loop, slab exchanges, fused PT kernels via the
+        # pallas_call batching rule) batches with a B-invariant collective
+        # budget (see `models.diffusion3d.make_multi_step`).
+        from ._batched import batched_stencil
+
+        return batched_stencil(multi, 5, donate_argnums=donate_argnums)
     return stencil(multi, donate_argnums=donate_argnums)
 
 
@@ -907,3 +931,60 @@ def run(
 
 def temperature(state):
     return state[0]
+
+
+def _pt_residual_block(params: Params):
+    """Per-block PT defect: ``max |div(qD)|`` over interior cells — the
+    pressure equation's residual, the criterion the PT relaxation drives to
+    zero.  Interior cells only: the outermost ring evolves under frozen
+    boundary faces (physical walls / halo planes) and its defect is not
+    driven by the local relaxation."""
+    import jax.numpy as jnp
+
+    dx, dy, dz = params.dx, params.dy, params.dz
+
+    def residual(T, Pf, qDx, qDy, qDz):
+        div = (
+            jnp.diff(qDx, axis=0) / dx
+            + jnp.diff(qDy, axis=1) / dy
+            + jnp.diff(qDz, axis=2) / dz
+        )
+        return jnp.max(jnp.abs(_inn(div)))
+
+    return residual
+
+
+def make_batched_residual(params: Params):
+    """Jitted per-member PT residual of a BATCHED state: ``(B,)`` array.
+
+    The serving loop's convergence criterion (ISSUE 8): member ``b``'s
+    residual is the global max of its block defects (`_pt_residual_block`
+    + `lax.pmax` over the mesh), replicated on every process so all ranks
+    mask the same members.  One cheap fused reduction — no collective
+    beyond the final scalar pmax per member batch.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.grid import global_grid
+    from ..parallel.topology import AXIS_NAMES
+    from ..utils.compat import shard_map
+    from ._batched import _batched_spec
+
+    block = _pt_residual_block(params)
+    gg = global_grid()
+    if gg.nprocs == 1 and not gg.force_spmd:
+        return jax.jit(lambda *s: jax.vmap(block)(*s))
+
+    def body(*state):
+        return lax.pmax(jax.vmap(block)(*state), AXIS_NAMES)
+
+    mapped = shard_map(
+        body,
+        mesh=gg.mesh,
+        in_specs=(_batched_spec(4),) * 5,
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -74,6 +74,15 @@ def _clear_caches() -> None:
     _fetch_cache.clear()
 
 
+def _telemetry_member_inc() -> None:
+    """Fold a member-sliced gather into the registry (``gather.*`` family:
+    the member path is the same gather, plus this attribution counter)."""
+    from ..utils import telemetry as _telemetry
+
+    if _telemetry.enabled():
+        _telemetry.counter("gather.member_calls").inc()
+
+
 def _block_fetch_fn(gg, ndim: int, block_shape, dtype, nsel: int = 1):
     """Compiled block fetch: replicate blocks ``sels`` onto every device.
 
@@ -390,9 +399,18 @@ def gather(
     *,
     root: int = 0,
     dedup: bool = False,
+    member: int | None = None,
     _force_chunked: bool = False,
 ):
     """Gather field ``A`` to the host on process ``root``.
+
+    ``member=k`` gathers ONE ensemble member of a BATCHED field (leading
+    batch axis, `models._batched`): member ``k`` is sliced on device first
+    (`member_field` — a per-device slice, so neither the root nor anyone
+    else ever materializes the other B-1 members), then the ordinary
+    gather path runs on the 3-D slice, folding its stats into the same
+    ``gather.*`` telemetry counters.  A batched field without ``member``
+    is rejected: its leading axis would be misread as grid dimension x.
 
     Returns the assembled numpy array on the root process and ``None`` on all
     other processes.  If ``A_global`` (a numpy array of matching size and
@@ -424,6 +442,37 @@ def gather(
 
     _grid.check_initialized()
     gg = _grid.global_grid()
+    from ..parallel.topology import NDIMS as _NDIMS
+
+    if member is not None:
+        from ..models._batched import member_field
+
+        if np.ndim(A) <= _NDIMS:
+            # gather legitimately accepts rank-1/2/3 fields on the 3-D grid,
+            # so a rank <= NDIMS array here is an ORDINARY grid field — with
+            # member= it would be silently misread (grid axis x sliced off
+            # as the "ensemble"); batched model fields are rank NDIMS+1.
+            raise ValueError(
+                f"gather(member={member}) needs a batched field (leading "
+                f"ensemble axis over grid-rank blocks, i.e. rank > "
+                f"{_NDIMS}); got rank {np.ndim(A)} — an unbatched grid "
+                f"field: drop member=."
+            )
+        B = int(np.shape(A)[0])
+        if not (0 <= int(member) < B):
+            raise ValueError(
+                f"member must be in [0, {B}) for this batched field; got "
+                f"{member}."
+            )
+        A = member_field(A, int(member))
+        _telemetry_member_inc()
+    elif np.ndim(A) > _NDIMS:
+        raise ValueError(
+            f"gather got a rank-{np.ndim(A)} field but the grid has "
+            f"{_NDIMS} dimensions; for a batched ensemble field pass "
+            f"member=k to gather one member (the leading axis is the "
+            f"ensemble, not grid dimension x)."
+        )
     # Reset FIRST: a gather that fails (or deadlocks and is restarted) must
     # not leave the previous call's stats lying around as if they were its
     # own — `last_gather_stats` is only ever the LAST COMPLETED call's view.

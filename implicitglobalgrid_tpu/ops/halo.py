@@ -46,6 +46,21 @@ Semantics ported exactly (with 0-based indices):
    surrounding stencil computation — the analogue of the reference's advice to
    group halo updates for pipelining
    (`/root/reference/src/update_halo.jl:13-14`).
+
+Batched/ensemble contract (ISSUE 8, `models._batched`): every traced-context
+path in this module — `exchange_dims_multi`, `update_halo_padded_faces`,
+`begin_slab_exchange`/`finish_slab_exchange`, the z-patch family — batches
+under `jax.vmap` over a leading ensemble axis with the SAME collective count
+at any B: the `lax.ppermute` batching rule carries the batch dimension
+inside the one hop (payload ×B, never B hops), and the coalesced packer's
+flatten/concat act on the per-member view so the width-group packing simply
+grows a batch axis.  This is pinned as a tier-1 lint
+(`analysis.budget.batched_budget_findings` — per-dimension ppermute counts
+at B=1 vs B=4 must be equal) and in the compiled-HLO cost baseline
+(`exchange/porous[coalesce=True,batch=4]`).  Code here must stay
+vmap-transparent: any new transport that branches on concrete batch state
+or issues per-member collectives breaks the B-for-the-price-of-1 invariant
+and the lint will fail it.
 """
 
 from __future__ import annotations

@@ -341,6 +341,7 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     """
     global _barrier_fn
     check_initialized()
+    from ..models import _batched as _batched_mod
     from ..ops import gather as _gather
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
@@ -350,6 +351,7 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     _stencil._clear_caches()
     _gather._clear_caches()
     _resilience._clear_caches()
+    _batched_mod._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
     if finalize_distributed:

@@ -1,0 +1,20 @@
+"""Batched multi-simulation serving (ISSUE 8; ROADMAP item 1).
+
+The steady-state loop for the million-users workload: a fixed-capacity slot
+pool holds B independent simulations batched along a leading ensemble axis
+(`models._batched`), one vmapped SPMD step advances every active member per
+round at ONE collective pair per exchanged dimension (B for the price of
+1), and a request queue admits/retires members MID-FLIGHT — per-member
+step budgets, per-member convergence masks (the porous PT residual), and
+per-member guard handling (a NaN in member k evicts or rolls back member
+k, never the batch).
+
+Public surface: `Request`, `MemberResult`, `ServingLoop` (see
+`serving.loop`); telemetry names and the event schema are documented in
+docs/observability.md, the knobs (``IGG_BATCH``,
+``IGG_BATCH_ROUND_STEPS``) in docs/usage.md.
+"""
+
+from .loop import MemberResult, Request, ServingLoop
+
+__all__ = ["Request", "MemberResult", "ServingLoop"]

@@ -1,0 +1,535 @@
+"""The serving loop: slot pool + request queue over one batched SPMD step.
+
+Design (ISSUE 8 tentpole):
+
+* **Slot pool** — the batched state ``(B, *block)`` per field IS the pool;
+  slot ``k`` holds member ``k``'s fields (zeros when free).  Admission
+  writes a member's state into its slot on device
+  (`models._batched.set_member_state`), retirement slices it back out
+  (`member_state`) — members enter and leave MID-FLIGHT while the others
+  keep stepping.
+* **One step, every member** — each round advances the whole pool through
+  ONE compiled vmapped multi-step (`make_multi_step(..., batch=True)`):
+  the collective budget is B-invariant, so a full pool costs the same
+  fabric traffic as a single simulation.  Members that must not advance
+  (free slots, converged members) are masked AFTER the step
+  (`select_members`): their state is bit-frozen, the reference semantics
+  of "this member is not running".
+* **Per-member convergence** — the porous PT residual criterion
+  (`porous_convection3d.make_batched_residual`) retires member ``k`` when
+  its residual drops under ``Request.tol``; diffusion/acoustic members
+  retire on their step budget (``Request.max_steps``).
+* **Per-member guards** — one batched finite probe per round
+  (`check_members_finite`); a non-finite member is rolled back to its last
+  good per-slot snapshot (``guard_policy="rollback"``) or evicted
+  (``"evict"``, the default) — the batch never pays for one member's NaN.
+* **Batched checkpoints** — ``checkpoint_every=N`` rounds writes the whole
+  pool (plus the serving metadata needed to resume: per-slot member ids,
+  tenants, step counts) through `utils.checkpoint.save_checkpoint`; a new
+  loop pointed at the same directory resumes mid-flight members.
+
+Telemetry (docs/observability.md): gauges ``serving.active_members``,
+``serving.queue_depth``; counters ``serving.admitted_total``,
+``serving.retired_total``, ``serving.converged_total``,
+``serving.evicted_total``, ``serving.rollbacks_total``,
+``serving.rounds``, ``serving.tenant.<tenant>.steps``; histogram
+``serving.member_t_eff_gbs`` (per-member T_eff: the member's must-stream
+bytes over the round wall time — every member of a round shares the wall
+time, which is the point of batching).  Events: ``serving.admit`` /
+``serving.retire`` / ``serving.converged`` / ``serving.evict`` /
+``serving.rollback``, each tagged with member id, slot, tenant and step
+count.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+
+import numpy as np
+
+from ..models import _batched
+from ..utils import config as _config
+from ..utils import telemetry as _telemetry
+
+#: Per-model serving adapter: state field names and which fields the
+#: per-member T_eff bytes model counts (`telemetry.teff_bytes` convention),
+#: plus whether the model has a PT residual to mask convergence on.
+_MODEL_INFO = {
+    "diffusion3d": dict(names=("T", "Cp"), stream=slice(0, 1), residual=False),
+    "acoustic3d": dict(
+        names=("P", "Vx", "Vy", "Vz"), stream=slice(0, 4), residual=False
+    ),
+    "porous_convection3d": dict(
+        names=("T", "Pf", "qDx", "qDy", "qDz"), stream=slice(0, 5),
+        residual=True,
+    ),
+}
+
+
+@dataclasses.dataclass
+class Request:
+    """One tenant's simulation request.
+
+    ``state`` is the member's initial state tuple (unbatched global-block
+    fields matching the loop's model); ``max_steps`` the retirement budget
+    (>= 1); ``tol`` (models with a residual) retires early once the
+    per-member PT residual drops below it.
+
+    Budgets retire at ROUND granularity: the pool advances
+    ``steps_per_round`` steps per round for every active member, so a
+    member retires at the first round boundary where ``steps >=
+    max_steps`` — up to ``steps_per_round - 1`` steps past the budget
+    (``MemberResult.steps`` reports the actual count).  Pick a
+    ``steps_per_round`` that divides your budgets for exact step counts.
+    """
+
+    state: tuple
+    max_steps: int
+    tenant: str = "default"
+    tol: float | None = None
+
+
+@dataclasses.dataclass
+class MemberResult:
+    """A retired member: final state + how it ended.
+
+    ``status``: ``"completed"`` (step budget reached), ``"converged"``
+    (residual under ``tol``), or ``"evicted"`` (non-finite state; ``state``
+    is None — poisoned fields are not handed back).
+    """
+
+    member: int
+    tenant: str
+    status: str
+    steps: int
+    state: tuple | None
+    residual: float | None = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    member: int = -1
+    tenant: str = ""
+    max_steps: int = 0
+    tol: float | None = None
+    steps: int = 0
+    active: bool = False
+    snapshot: tuple | None = None
+    snapshot_steps: int = 0
+    rollbacks: int = 0
+
+
+class ServingLoop:
+    """Fixed-capacity batched serving of one model (module docstring).
+
+    ``model`` is a model module (`models.diffusion3d` / `acoustic3d` /
+    `porous_convection3d`); ``params`` its `Params` (one physics/numerics
+    config per pool — members vary by state, the ensemble contract).
+    ``capacity`` defaults to ``IGG_BATCH`` (env) else 4;
+    ``steps_per_round`` to ``IGG_BATCH_ROUND_STEPS`` else 1.
+    ``step_kwargs`` pass through to ``make_multi_step`` (``exchange_every``,
+    ``fused_k``, ...).  ``guard_policy``: ``"evict"`` | ``"rollback"`` |
+    ``"off"``.  ``max_rollbacks`` bounds per-member rollbacks before the
+    member is evicted anyway (a deterministic fault re-trips forever).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        capacity: int | None = None,
+        steps_per_round: int | None = None,
+        guard_policy: str = "evict",
+        max_rollbacks: int = 3,
+        checkpoint_every: int = 0,
+        checkpoint_dir: str | None = None,
+        step_kwargs: dict | None = None,
+    ):
+        name = model.__name__.rsplit(".", 1)[-1]
+        if name not in _MODEL_INFO:
+            raise ValueError(
+                f"ServingLoop supports {sorted(_MODEL_INFO)}, got {name!r}"
+            )
+        if guard_policy not in ("evict", "rollback", "off"):
+            raise ValueError(
+                f"guard_policy must be 'evict', 'rollback' or 'off', got "
+                f"{guard_policy!r}"
+            )
+        if capacity is None:
+            capacity = _config.batch_env() or 4
+        if steps_per_round is None:
+            steps_per_round = _config.batch_round_steps_env() or 1
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1 (got {capacity})")
+        if steps_per_round < 1:
+            raise ValueError(
+                f"steps_per_round must be >= 1 (got {steps_per_round})"
+            )
+        if checkpoint_every and not checkpoint_dir:
+            raise ValueError(
+                "checkpoint_every > 0 requires a checkpoint_dir"
+            )
+        self.model = model
+        self.model_name = name
+        self.info = _MODEL_INFO[name]
+        self.params = params
+        self.capacity = int(capacity)
+        self.steps_per_round = int(steps_per_round)
+        self.guard_policy = guard_policy
+        self.max_rollbacks = int(max_rollbacks)
+        self.checkpoint_every = int(checkpoint_every)
+        self.checkpoint_dir = checkpoint_dir
+        # donate=False: the raw step's inputs survive for the post-step
+        # mask select (which donates both and recycles the buffers).
+        self._step = model.make_multi_step(
+            params, self.steps_per_round, donate=False, batch=True,
+            **(step_kwargs or {}),
+        )
+        self._residual_fn = (
+            model.make_batched_residual(params) if self.info["residual"]
+            else None
+        )
+        self.slots = [_Slot() for _ in range(self.capacity)]
+        # (member id, request) pairs awaiting a free slot
+        self.queue: collections.deque[tuple[int, Request]] = collections.deque()
+        self.results: dict[int, MemberResult] = {}
+        self.rounds = 0
+        self._next_member = 0
+        self._state = None  # built lazily from the first admitted state
+        self._blank = None  # zero member state for freed slots
+        self._sig = None    # pool field signature: ((global shape, dtype), ...)
+
+    # -- pool state -----------------------------------------------------------
+
+    @property
+    def active_members(self) -> int:
+        return sum(s.active for s in self.slots)
+
+    def _ensure_pool(self, like_state: tuple) -> None:
+        """Build the B-slot pool from the first member's field signature."""
+        if self._state is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        zeros = tuple(
+            jax.jit(jnp.zeros_like)(A) for A in like_state
+        )
+        self._blank = zeros
+        self._state = _batched.stack_states([zeros] * self.capacity)
+        if self._sig is None:
+            # prime() path: the pool's signature comes from the priming
+            # state, so the FIRST submit after a resume is validated
+            # against the actual pool, not adopted blindly.
+            self._sig = self._state_sig(like_state)
+
+    def _mask(self) -> np.ndarray:
+        return np.asarray([s.active for s in self.slots], bool)
+
+    @staticmethod
+    def _state_sig(state) -> tuple:
+        return tuple(
+            (tuple(np.shape(A)), str(getattr(A, "dtype", type(A))))
+            for A in state
+        )
+
+    def _check_signature(self, state) -> None:
+        """Reject a member state that does not match the pool's field
+        signature AT SUBMIT TIME: `set_member_state` zips fields (silent
+        truncation) and casts dtypes (silently breaking bit-exactness), so
+        a mismatch must never reach admission.  The first state seen
+        (first submit or `prime`) defines the signature."""
+        sig = self._state_sig(state)
+        if self._sig is None:
+            nf = len(self.info["names"])
+            if len(sig) != nf:
+                raise ValueError(
+                    f"{self.model_name} state has fields "
+                    f"{self.info['names']}; got {len(sig)} field(s)."
+                )
+            self._sig = sig
+            return
+        if sig != self._sig:
+            raise ValueError(
+                f"request state signature {sig} does not match the pool's "
+                f"{self._sig} — one pool serves one field signature "
+                f"(same grid, same dtype)."
+            )
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Queue one request; returns its member id.  Admission into a free
+        slot happens immediately when one is available, else at the next
+        round boundary after a retirement frees one.  Invalid requests are
+        rejected HERE, before anything is queued or written into the pool
+        — a bad request must never detonate mid-service half-admitted."""
+        if request.tol is not None and not self.info["residual"]:
+            raise ValueError(
+                f"{self.model_name} has no PT residual; tol applies to "
+                f"porous members only (use max_steps)."
+            )
+        if int(request.max_steps) < 1:
+            raise ValueError(
+                f"max_steps must be >= 1 (got {request.max_steps})"
+            )
+        self._check_signature(request.state)
+        member = self._next_member
+        self._next_member += 1
+        self.queue.append((member, request))
+        _telemetry.gauge("serving.queue_depth").set(len(self.queue))
+        self._admit_from_queue()
+        return member
+
+    def _admit_from_queue(self) -> None:
+        for k, slot in enumerate(self.slots):
+            if not self.queue:
+                break
+            if slot.active:
+                continue
+            member, req = self.queue.popleft()
+            self._ensure_pool(req.state)
+            self._state = _batched.set_member_state(
+                self._state, req.state, k
+            )
+            tol = req.tol
+            self.slots[k] = _Slot(
+                member=member, tenant=req.tenant,
+                max_steps=int(req.max_steps), tol=tol, active=True,
+            )
+            if self.guard_policy == "rollback":
+                self.slots[k].snapshot = _batched.member_state(self._state, k)
+                self.slots[k].snapshot_steps = 0
+            _telemetry.counter("serving.admitted_total").inc()
+            _telemetry.event(
+                "serving.admit", member=member, slot=k, tenant=req.tenant,
+                max_steps=int(req.max_steps), tol=tol,
+            )
+        _telemetry.gauge("serving.active_members").set(self.active_members)
+        _telemetry.gauge("serving.queue_depth").set(len(self.queue))
+
+    # -- retirement -----------------------------------------------------------
+
+    def _retire(self, k: int, status: str, residual: float | None = None):
+        slot = self.slots[k]
+        state = (
+            None if status == "evicted"
+            else _batched.member_state(self._state, k)
+        )
+        self.results[slot.member] = MemberResult(
+            member=slot.member, tenant=slot.tenant, status=status,
+            steps=slot.steps, state=state, residual=residual,
+        )
+        _telemetry.counter("serving.retired_total").inc()
+        etype = {
+            "completed": "serving.retire",
+            "converged": "serving.converged",
+            "evicted": "serving.evict",
+        }[status]
+        if status == "converged":
+            _telemetry.counter("serving.converged_total").inc()
+        if status == "evicted":
+            _telemetry.counter("serving.evicted_total").inc()
+        _telemetry.event(
+            etype, member=slot.member, slot=k, tenant=slot.tenant,
+            steps=slot.steps, status=status, residual=residual,
+        )
+        # Free the slot: blank state so an idle slot can never leak the
+        # retired member's fields into a future snapshot/result.
+        self._state = _batched.set_member_state(self._state, self._blank, k)
+        self.slots[k] = _Slot()
+
+    # -- the round ------------------------------------------------------------
+
+    def run_round(self) -> None:
+        """One serving round: step active members, guard, retire, admit."""
+        self._admit_from_queue()
+        mask = self._mask()
+        if self._state is not None and mask.any():
+            t0 = time.perf_counter()
+            new = self._step(*self._state)
+            # Masking AFTER the step bit-freezes non-running members; the
+            # step itself ran every slot (that is what batching means — the
+            # flops of idle slots are the price of the shared program).
+            self._state = _batched.select_members(mask, new, self._state)
+            import jax
+
+            jax.block_until_ready(self._state)
+            dt = time.perf_counter() - t0
+            for k, slot in enumerate(self.slots):
+                if slot.active:
+                    slot.steps += self.steps_per_round
+                    _telemetry.counter(
+                        f"serving.tenant.{slot.tenant}.steps"
+                    ).inc(self.steps_per_round)
+            if dt > 0:
+                from ..utils.telemetry import teff_bytes
+
+                member_bytes = teff_bytes(
+                    self._blank[self.info["stream"]]
+                ) * self.steps_per_round
+                gbs = member_bytes / dt / 1e9
+                for k, slot in enumerate(self.slots):
+                    if slot.active:
+                        _telemetry.histogram(
+                            "serving.member_t_eff_gbs"
+                        ).record(gbs)
+            self._guard(mask)
+            self._convergence()
+        # Step-budget retirement (after guard: never hand back unguarded
+        # state) and back-fill from the queue.
+        for k, slot in enumerate(self.slots):
+            if slot.active and slot.steps >= slot.max_steps:
+                self._retire(k, "completed")
+        self.rounds += 1
+        _telemetry.counter("serving.rounds").inc()
+        if (
+            self.checkpoint_every
+            and self.rounds % self.checkpoint_every == 0
+            and self._state is not None
+        ):
+            self._save_checkpoint()
+        self._admit_from_queue()
+
+    def _guard(self, mask: np.ndarray) -> None:
+        if self.guard_policy == "off":
+            return
+        bad = _batched.check_members_finite(self._state)
+        for k in np.flatnonzero(bad & mask):
+            slot = self.slots[int(k)]
+            if (
+                self.guard_policy == "rollback"
+                and slot.snapshot is not None
+                and slot.rollbacks < self.max_rollbacks
+            ):
+                slot.rollbacks += 1
+                self._state = _batched.set_member_state(
+                    self._state, slot.snapshot, int(k)
+                )
+                slot.steps = slot.snapshot_steps
+                _telemetry.counter("serving.rollbacks_total").inc()
+                _telemetry.event(
+                    "serving.rollback", member=slot.member, slot=int(k),
+                    tenant=slot.tenant, to_steps=slot.snapshot_steps,
+                    rollbacks=slot.rollbacks,
+                )
+            else:
+                self._retire(int(k), "evicted")
+        if self.guard_policy == "rollback":
+            # Refresh per-slot snapshots from guard-passed state only.
+            still = ~_batched.check_members_finite(self._state) if bad.any() \
+                else ~bad
+            for k, slot in enumerate(self.slots):
+                if slot.active and still[k]:
+                    slot.snapshot = _batched.member_state(self._state, k)
+                    slot.snapshot_steps = slot.steps
+
+    def _convergence(self) -> None:
+        if self._residual_fn is None:
+            return
+        if not any(s.active and s.tol is not None for s in self.slots):
+            return
+        res = np.asarray(self._residual_fn(*self._state))
+        for k, slot in enumerate(self.slots):
+            if (
+                slot.active
+                and slot.tol is not None
+                and float(res[k]) < slot.tol
+            ):
+                self._retire(k, "converged", residual=float(res[k]))
+
+    def run(self, max_rounds: int | None = None) -> dict[int, MemberResult]:
+        """Drive rounds until the queue and the pool are empty (or
+        ``max_rounds`` is hit).  Returns the results map."""
+        n = 0
+        while (self.queue or self.active_members) and (
+            max_rounds is None or n < max_rounds
+        ):
+            self.run_round()
+            n += 1
+        return self.results
+
+    # -- batched checkpointing ------------------------------------------------
+
+    def _serving_meta(self) -> dict:
+        return {
+            "serving": {
+                "model": self.model_name,
+                "rounds": self.rounds,
+                "next_member": self._next_member,
+                "slots": [
+                    {
+                        "member": s.member, "tenant": s.tenant,
+                        "max_steps": s.max_steps, "tol": s.tol,
+                        "steps": s.steps, "active": s.active,
+                    }
+                    for s in self.slots
+                ],
+            }
+        }
+
+    def _save_checkpoint(self) -> str:
+        from ..utils import checkpoint as _ckpt
+
+        return _ckpt.save_checkpoint(
+            self.checkpoint_dir, self._state, self.rounds,
+            extra=self._serving_meta(),
+        )
+
+    def prime(self, like_state: tuple) -> None:
+        """Build the (empty) slot pool from one member state's field
+        signature WITHOUT admitting anything — the public priming step
+        `resume()` needs (restore requires a ``like=`` pool of the right
+        shapes; a submitted request must never be the donor, its state
+        would be clobbered by the restored pool)."""
+        self._ensure_pool(tuple(like_state))
+
+    def resume(self) -> bool:
+        """Restore pool + slot metadata from ``checkpoint_dir`` (strict
+        same-topology restore — a serving pool lives on one deployment).
+        Returns True when a checkpoint was found.  Queue contents are not
+        persisted (requests not yet admitted belong to the caller).
+        Requires a `prime`-d, still-EMPTY pool: resuming over live members
+        would silently destroy them, so that is refused."""
+        from ..utils import checkpoint as _ckpt
+
+        latest = _ckpt.latest_checkpoint(self.checkpoint_dir)
+        if latest is None:
+            return False
+        if self._state is None:
+            raise RuntimeError(
+                "resume() needs the pool built first: call "
+                "loop.prime(member_state) with one state of the right "
+                "signature before resuming."
+            )
+        if self.active_members or self.queue:
+            raise RuntimeError(
+                "resume() would overwrite live members: restore into a "
+                "fresh loop (prime + resume) before submitting requests."
+            )
+        state, rounds, extra = _ckpt.restore_checkpoint(
+            latest, like=self._state, strict=True, verify=False
+        )
+        meta = extra.get("serving", {})
+        if meta.get("model") != self.model_name:
+            raise ValueError(
+                f"checkpoint is a {meta.get('model')!r} pool, this loop "
+                f"serves {self.model_name!r}"
+            )
+        self._state = state
+        self.rounds = int(rounds)
+        self._next_member = int(meta.get("next_member", self._next_member))
+        for k, rec in enumerate(meta.get("slots", [])[: self.capacity]):
+            self.slots[k] = _Slot(
+                member=int(rec["member"]), tenant=rec["tenant"],
+                max_steps=int(rec["max_steps"]), tol=rec["tol"],
+                steps=int(rec["steps"]), active=bool(rec["active"]),
+            )
+            if self.guard_policy == "rollback" and self.slots[k].active:
+                self.slots[k].snapshot = _batched.member_state(self._state, k)
+                self.slots[k].snapshot_steps = self.slots[k].steps
+        _telemetry.gauge("serving.active_members").set(self.active_members)
+        return True

@@ -68,6 +68,14 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_GATHER_BATCH``      blocks fetched per compiled dispatch in the
                           multi-host gather (int, clamped to >= 1, default
                           8; `ops.gather._gather_batch_size`)
+``IGG_BATCH``             default slot-pool capacity B of the batched
+                          serving loop (`serving.ServingLoop`; int >= 1,
+                          default 4) — B ensemble members share one vmapped
+                          SPMD step at ONE collective pair per exchanged
+                          dimension (read per loop construction)
+``IGG_BATCH_ROUND_STEPS`` default steps advanced per serving round (int >=
+                          1, default 1; `serving.ServingLoop`) — the
+                          admit/retire/guard granularity of the slot pool
 ``IGG_TELEMETRY``         telemetry master switch (``0`` disables the
                           metrics registry, the event log and every
                           instrumented hot path to their zero-allocation
@@ -282,6 +290,19 @@ def gather_batch_env() -> int | None:
     `ops.gather` behavior for 0/negative values.
     """
     return _int_env("IGG_GATHER_BATCH")
+
+
+# -- Batched serving knobs (read per loop construction; docs/usage.md) --------
+
+
+def batch_env() -> int | None:
+    """``IGG_BATCH``: default serving slot-pool capacity B (>= 1)."""
+    return _int_env("IGG_BATCH", minimum=1)
+
+
+def batch_round_steps_env() -> int | None:
+    """``IGG_BATCH_ROUND_STEPS``: default steps per serving round (>= 1)."""
+    return _int_env("IGG_BATCH_ROUND_STEPS", minimum=1)
 
 
 # -- Telemetry knobs (read per call; docs/observability.md) -------------------

@@ -3,7 +3,10 @@
 
 Compares a candidate bench record against a reference within per-metric
 tolerance bands (ROADMAP item 5: "a perf regression fails a PR the way a
-collective-count regression already does").  Defaults compare the two
+collective-count regression already does").  Gated metrics are the
+headline plus every nested `teff`/`teff_grad`/`members_per_s` under
+``extras`` (`analysis.perf.GATED_KEYS` — the last is `bench.py batch`'s
+batched-serving members/s/chip sweep).  Defaults compare the two
 newest parseable committed rounds — the self-consistency check the
 ``bench-regression`` tier-1 pass also runs; pass ``--candidate`` to gate a
 FRESH ``bench.py`` record before committing it.

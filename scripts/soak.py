@@ -43,9 +43,17 @@ that the restarted child's `igg.dump_metrics` output is valid JSON +
 Prometheus text with per-step ``T_eff`` recorded — the soak consumes the
 telemetry snapshot instead of private tallies.
 
-``--quick`` runs only the ``elastic_failover`` drill at small size — the
-fast crash→shrunk-topology-restart smoke path (registered next to the
-tier-1 command in docs/testing.md).
+* ``serving`` — the batched-serving smoke (ISSUE 8): a 2-slot
+  `serving.ServingLoop` pool takes 4 requests, so members admit and retire
+  MID-FLIGHT; one member converges on the porous PT residual mask, one
+  retires on its step budget, a NaN-poisoned member is evicted without
+  touching its batch-mates, and the late member runs in the freed slot.
+  The orchestrator re-verifies the ``serving.*`` event schema
+  (docs/observability.md) from the JSONL log.
+
+``--quick`` runs the ``elastic_failover`` drill plus the ``serving`` smoke
+at small size — the fast smoke path (registered next to the tier-1 command
+in docs/testing.md).
 """
 
 from __future__ import annotations
@@ -60,7 +68,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 CRASH_STATUS = 17  # FaultInjector.CRASH_STATUS
-SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash", "elastic_failover")
+SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash",
+             "elastic_failover", "serving")
 
 
 def _free_port() -> int:
@@ -197,6 +206,118 @@ def child_elastic_main(args) -> int:
     igg.finalize_global_grid()
     print("SOAK CHILD OK", flush=True)
     return 0
+
+
+def child_serving_main(args) -> int:
+    """The batched-serving smoke (ISSUE 8): a `serving.ServingLoop` slot
+    pool must admit and retire members MID-FLIGHT — more requests than
+    slots, per-member convergence masking (porous PT residual), a NaN
+    member evicted without touching its batch-mates — with the event
+    timeline proving the order.  Asserts in-child; the orchestrator
+    re-verifies the event schema from the JSONL log."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.devices}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import porous_convection3d as porous
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+
+    nx = args.nx
+    igg.init_global_grid(nx, nx, nx, quiet=True)
+    _, params = porous.setup(nx, nx, nx, init_grid=False, npt=3)
+    loop = ServingLoop(porous, params, capacity=2, steps_per_round=1)
+
+    def member(scale):
+        s, _ = porous.setup(nx, nx, nx, init_grid=False, npt=3,
+                            ic_scale=scale)
+        return s
+
+    # 4 requests through 2 slots: member 0 converges on a loose residual
+    # tolerance, member 1 retires on its step budget, member 2 is poisoned
+    # (evicted), member 3 is only admitted once a slot frees MID-FLIGHT.
+    m_conv = loop.submit(Request(state=member(1.0), max_steps=50, tol=1.0,
+                                 tenant="conv"))
+    m_budget = loop.submit(Request(state=member(0.7), max_steps=2,
+                                   tenant="budget"))
+    bad = member(0.5)
+    bad_T = np.asarray(bad[0]).copy()
+    bad_T[(1,) * bad_T.ndim] = np.nan
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gg = igg.get_global_grid()
+    badt = jax.device_put(
+        bad_T, NamedSharding(gg.mesh, P(*igg.AXIS_NAMES[: bad_T.ndim]))
+    )
+    m_bad = loop.submit(Request(state=(badt,) + tuple(bad[1:]), max_steps=9,
+                                tenant="bad"))
+    m_late = loop.submit(Request(state=member(0.9), max_steps=2,
+                                 tenant="late"))
+    results = loop.run(max_rounds=60)
+    assert results[m_conv].status == "converged", results[m_conv]
+    assert results[m_budget].status == "completed", results[m_budget]
+    assert results[m_bad].status == "evicted", results[m_bad]
+    assert results[m_late].status == "completed", results[m_late]
+    # Mid-flight admission: the late member entered a slot AFTER the pool
+    # had already retired someone (queue > capacity forces it).
+    assert loop.rounds > 1 and len(results) == 4
+    for m, r in results.items():
+        if r.state is not None:
+            assert all(np.isfinite(np.asarray(A)).all() for A in r.state), m
+    snap = igg.telemetry_snapshot()
+    c = snap["counters"]
+    assert c.get("serving.admitted_total") == 4, c
+    assert c.get("serving.retired_total") == 4, c
+    assert c.get("serving.evicted_total") == 1, c
+    assert c.get("serving.converged_total") == 1, c
+    igg.finalize_global_grid()
+    print("SOAK SERVING OK", flush=True)
+    return 0
+
+
+def _verify_serving_events(tele_dir: str) -> tuple[bool, str]:
+    """Orchestrator-side check of the serving event schema
+    (docs/observability.md): all four event types present, every one
+    tagged with member/slot/tenant, and at least one admit AFTER the
+    first retirement (the mid-flight slot reuse)."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu.utils.telemetry import read_events
+
+    files = sorted(glob.glob(os.path.join(tele_dir, "events*.jsonl")))
+    if not files:
+        return False, f"no events*.jsonl under {tele_dir}"
+    events = [e for f in files for e in read_events(f)]
+    serving = [e for e in events if str(e.get("type", "")).startswith("serving.")]
+    kinds = {e["type"] for e in serving}
+    need = {"serving.admit", "serving.retire", "serving.converged",
+            "serving.evict"}
+    if not need <= kinds:
+        return False, f"missing event type(s) {sorted(need - kinds)}"
+    for e in serving:
+        if any(k not in e for k in ("member", "slot", "tenant")):
+            return False, f"event {e['type']} missing member/slot/tenant tags"
+    serving.sort(key=lambda e: e["ts"])
+    first_retire = next(
+        i for i, e in enumerate(serving) if e["type"] != "serving.admit"
+    )
+    if not any(
+        e["type"] == "serving.admit" for e in serving[first_retire:]
+    ):
+        return False, "no mid-flight admission (admit after a retirement)"
+    return True, (
+        f"{len(serving)} serving events: admit/retire/converged/evict all "
+        f"present, mid-flight admission confirmed"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -483,7 +604,7 @@ def orchestrate(args) -> int:
     # The elastic drill carries its own oracle (a different topology); the
     # shared 8-device baseline is only needed by the other scenarios.
     baseline = None
-    if any(s != "elastic_failover" for s in args.scenarios):
+    if any(s not in ("elastic_failover", "serving") for s in args.scenarios):
         proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
         if proc.returncode != 0:
             print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
@@ -495,6 +616,28 @@ def orchestrate(args) -> int:
     for scenario in args.scenarios:
         if scenario == "elastic_failover":
             if not supervise_elastic_failover(args):
+                failures += 1
+            continue
+        if scenario == "serving":
+            import shutil
+
+            tele_dir = os.path.join(args.workdir, "telemetry_serving")
+            shutil.rmtree(tele_dir, ignore_errors=True)
+            env = _elastic_env(
+                {"IGG_TELEMETRY": "1", "IGG_TELEMETRY_DIR": tele_dir}
+            )
+            proc = _run_child(
+                [sys.executable, os.path.abspath(__file__),
+                 "--serving-child", "--nx", str(args.nx),
+                 "--devices", str(args.devices)],
+                env, args.timeout,
+            )
+            ok = proc.returncode == 0
+            detail = f"rc={proc.returncode}"
+            if ok:
+                ok, detail = _verify_serving_events(tele_dir)
+            if not _report("serving", ok, detail):
+                print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
                 failures += 1
             continue
         if scenario == "init_flake":
@@ -568,13 +711,16 @@ def main() -> int:
     ap.add_argument("--timeout", type=int, default=600)
     ap.add_argument(
         "--quick", action="store_true",
-        help="fast fault smoke path: only the elastic_failover drill "
-        "(crash -> fallback past the corrupt generation -> shrunk-topology "
-        "restart) at small size — the CI lane registered in docs/testing.md",
+        help="fast smoke path: the elastic_failover drill (crash -> "
+        "fallback past the corrupt generation -> shrunk-topology restart) "
+        "plus the batched-serving loop smoke (mid-flight admit/retire, "
+        "per-member convergence masking) at small size — the CI lane "
+        "registered in docs/testing.md",
     )
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--elastic-child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--serving-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
     ap.add_argument("--distributed", action="store_true", help=argparse.SUPPRESS)
@@ -586,10 +732,12 @@ def main() -> int:
     args = ap.parse_args()
     if args.elastic_child:
         return child_elastic_main(args)
+    if args.serving_child:
+        return child_serving_main(args)
     if args.child:
         return child_main(args)
     if args.quick:
-        args.scenarios = ["elastic_failover"]
+        args.scenarios = ["elastic_failover", "serving"]
         args.steps = min(args.steps, 6)
         args.timeout = min(args.timeout, 300)
     return orchestrate(args)

@@ -149,6 +149,30 @@ def test_gate_metrics_selects_throughput_not_wall_time():
     }
 
 
+def test_gate_metrics_maps_batch_members_per_s():
+    """ISSUE 8: the ``bench.py batch`` record's members/s/chip metrics are
+    gated — every sweep row and the headline rate — so a batching
+    regression fails the bench-regression pass like a bandwidth drop."""
+    rec = {
+        "extras": {
+            "batch_ensemble": {
+                "members_per_s": 12.0,
+                "throughput_multiplier": 6.1,  # not a gated key
+                "sweep": {
+                    "B1": {"members_per_s": 2.0, "t_step_ms": 1.0},
+                    "B8": {"members_per_s": 12.0, "t_step_ms": 1.3},
+                },
+            },
+        },
+    }
+    assert perf.gate_metrics(rec) == {
+        "batch_ensemble.members_per_s": 12.0,
+        "batch_ensemble.sweep.B1.members_per_s": 2.0,
+        "batch_ensemble.sweep.B8.members_per_s": 12.0,
+    }
+    assert "members_per_s" in perf.GATED_KEYS
+
+
 # -- comparison + waivers -----------------------------------------------------
 
 

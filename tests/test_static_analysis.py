@@ -715,6 +715,56 @@ def test_hlo_budget_cross_check_flags_unaccounted_payloads():
     assert found[0].severity == "WARNING"
 
 
+def test_batched_census_fires_on_collective_count_mismatch():
+    """Seeded positive fixture (ISSUE 8): a batched-exchange census whose
+    B>1 counts differ from the B=1 baseline must fail — the
+    B-for-the-price-of-1 claim as a static invariant — and the matching
+    census must stay quiet."""
+    from implicitglobalgrid_tpu.analysis.budget import (
+        batched_census_findings,
+    )
+
+    base = {"x": 2, "y": 2, "z": 2}
+    # clean: identical counts at every B
+    assert batched_census_findings(
+        {"diffusion": {1: dict(base), 4: dict(base)}}
+    ) == []
+
+    # regression: the B=4 exchange re-serialized per member in x
+    found = batched_census_findings(
+        {"diffusion": {1: dict(base), 4: {"x": 8, "y": 2, "z": 2}}}
+    )
+    assert [f.code for f in found] == ["batched-budget-mismatch"]
+    assert found[0].symbol == "diffusion/batch4"
+    assert "re-serialized" in found[0].message
+
+    # a baseline that saw no collectives is a broken census, not a pass
+    assert [
+        f.code
+        for f in batched_census_findings(
+            {"porous": {1: {"x": 0, "y": 0, "z": 0}}}
+        )
+    ] == ["census-broken"]
+
+
+def test_batched_census_real_trace_is_b_invariant():
+    """The REAL traced census: every model's coalesced exchange must emit
+    identical per-dimension ppermute counts at B=1 and B=4 (tier-1 also
+    runs this through the suite's `budget.run`)."""
+    from implicitglobalgrid_tpu.analysis.budget import (
+        BATCHED_CENSUS_B,
+        batched_budget_findings,
+        batched_exchange_census,
+    )
+
+    census = batched_exchange_census()
+    assert set(census) == {"diffusion", "acoustic", "porous"}
+    for model, variants in census.items():
+        assert variants[1] == variants[BATCHED_CENSUS_B], (model, variants)
+        assert sum(variants[1].values()) > 0, (model, variants)
+    assert batched_budget_findings() == []
+
+
 def test_entry_budget_census_fires_on_per_field_regression():
     """The suite path counts the SHARED traced entries: a coalesce=True
     entry showing per-field collective counts must fire, and a control

@@ -421,3 +421,83 @@ def test_snapshot_is_json_serializable():
     rt = json.loads(json.dumps(snap))
     assert rt["counters"]["s.c"] == 1
     assert rt["coords"] == list(igg.get_global_grid().coords)
+
+
+# -- Batched serving metrics + event schema (ISSUE 8) -------------------------
+
+
+def test_serving_metrics_and_event_schema(monkeypatch, tmp_path):
+    """The serving loop's observability contract (docs/observability.md):
+    ``serving.active_members`` tracks the pool live, the retire family of
+    counters splits by outcome, per-member T_eff is recorded per round,
+    per-tenant step counters accumulate, and every ``serving.*`` event is
+    tagged with member/slot/tenant."""
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path / "tele"))
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
+    _, params = diffusion3d.setup(8, 8, 8, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=2, steps_per_round=1)
+
+    def req(scale, steps, tenant):
+        s, _ = diffusion3d.setup(8, 8, 8, init_grid=False, ic_scale=scale)
+        return Request(state=s, max_steps=steps, tenant=tenant)
+
+    loop.submit(req(1.0, 2, "alice"))
+    loop.submit(req(1.1, 1, "bob"))
+    snap = tele.snapshot()
+    assert snap["gauges"]["serving.active_members"] == 2
+    loop.run(max_rounds=10)
+
+    snap = tele.snapshot()
+    c = snap["counters"]
+    assert c["serving.admitted_total"] == 2
+    assert c["serving.retired_total"] == 2
+    assert c.get("serving.evicted_total", 0) == 0
+    assert c["serving.tenant.alice.steps"] == 2
+    assert c["serving.tenant.bob.steps"] == 1
+    assert c["serving.rounds"] == loop.rounds
+    assert snap["gauges"]["serving.active_members"] == 0
+    # per-member T_eff tagging: one histogram sample per active member per
+    # round (round 1: both members, round 2: alice alone)
+    assert snap["histograms"]["serving.member_t_eff_gbs"]["count"] == 3
+
+    events = tele.read_events(tmp_path / "tele" / "events.jsonl")
+    serving = [e for e in events if e["type"].startswith("serving.")]
+    assert {e["type"] for e in serving} == {"serving.admit",
+                                           "serving.retire"}
+    for e in serving:
+        assert {"member", "slot", "tenant"} <= set(e), e
+    retires = [e for e in serving if e["type"] == "serving.retire"]
+    assert {e["tenant"] for e in retires} == {"alice", "bob"}
+    assert all(e["status"] == "completed" for e in retires)
+
+
+def test_serving_disabled_telemetry_is_noop(monkeypatch):
+    """``IGG_TELEMETRY=0``: the loop still serves, nothing is recorded."""
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
+    s, params = diffusion3d.setup(8, 8, 8, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=1, steps_per_round=1)
+    m = loop.submit(Request(state=s, max_steps=1, tenant="x"))
+    res = loop.run(max_rounds=5)
+    assert res[m].status == "completed"
+    monkeypatch.delenv("IGG_TELEMETRY")
+    assert tele.snapshot()["counters"] == {}
+
+
+def test_gather_member_counter_folds_into_gather_family(monkeypatch):
+    from implicitglobalgrid_tpu.models import _batched
+
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    A = igg.zeros((8, 8, 8), "float32")
+    B = _batched.stack_fields(A, A)
+    got = igg.gather(B, member=1)
+    assert got is not None and got.shape == (16, 16, 16)  # dims (2,2,2)
+    snap = tele.snapshot()
+    assert snap["counters"]["gather.member_calls"] == 1
+    assert snap["counters"]["gather.calls"] == 1  # the slice gather itself
