@@ -448,18 +448,40 @@ def main():
     # + the B=8-vs-B=1 compiled collective-count A/B.
     _extra("batch_ensemble", _batch_extra)
     _extra("batch_hlo_ab", _batch_hlo_extra)
+
+    def _efficiency():
+        # ISSUE 10: the cost-model reconciliation — achieved-vs-modeled
+        # traffic per model (analysis/reconcile.py, compiled fresh on the
+        # virtual CPU mesh), joined with THIS record's measured teffs:
+        # measured_teff / achieved_fraction = the modeled GB/s the chip
+        # actually sustained.  efficiency.*.achieved_fraction is a
+        # reported (not yet gated) perf-gate key (analysis.perf).
+        from implicitglobalgrid_tpu.analysis.reconcile import join_measured
+
+        report = _cpu_mesh_json(["reconcile"])
+        measured = {
+            "diffusion": extras.get("diffusion_xla", {}).get("teff"),
+            "acoustic": extras.get("acoustic", {}).get("teff"),
+            "porous": extras.get("porous_pt", {}).get("teff"),
+        }
+        return join_measured(report, measured)
+
+    _extra("efficiency", _efficiency)
     # The observability surface is the record of record now: every bench
     # above folded its measurement into the process registry (`_emit`), so
     # the snapshot ships in the artifact instead of a private tally
-    # (docs/observability.md).
+    # (docs/observability.md) — since ISSUE 10 with the host-span summary
+    # alongside.
     try:
         import implicitglobalgrid_tpu as igg
+        from implicitglobalgrid_tpu.utils.tracing import span_summary
 
         snap = igg.telemetry_snapshot()
         extras["telemetry"] = {
             "counters": snap["counters"],
             "gauges": snap["gauges"],
             "histograms": snap["histograms"],
+            "spans": span_summary(),
         }
     except Exception as e:  # never let instrumentation sink the artifact
         extras["telemetry"] = {"error": f"{type(e).__name__}: {e}"}

@@ -792,7 +792,7 @@ def main():
     p.add_argument("what", nargs="?", default="all",
                    choices=["diffusion", "acoustic", "porous", "weak",
                             "coalesce", "grad", "batch", "batch_hlo",
-                            "all"])
+                            "reconcile", "all"])
     p.add_argument("--batch-sizes", default="1,2,4,8",
                    help="comma-separated B sweep for the batch mode")
     p.add_argument("--n", type=int, default=None)
@@ -858,6 +858,14 @@ def main():
         )
     if a.what == "batch_hlo":
         batch_hlo_ab()
+    if a.what == "reconcile":
+        # Cost-model reconciliation (ISSUE 10): fresh XLA:CPU compiles of
+        # the cadence matrix -> achieved_fraction per model, one JSON line
+        # (bench.py runs this mode on the virtual CPU mesh and joins the
+        # result with its measured teffs as extras.efficiency).
+        from implicitglobalgrid_tpu.analysis.reconcile import reconcile_report
+
+        print(json.dumps(reconcile_report(source="compiled")), flush=True)
     if a.what == "grad":
         bench_diffusion_grad(n=a.n or 256, chunk=a.chunk, reps=a.reps,
                              dtype=a.dtype, fused_k=a.fused_k or 4,

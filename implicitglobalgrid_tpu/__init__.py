@@ -35,7 +35,13 @@ per-process JSONL event log (`utils.telemetry`), per-step wall-time /
 steps-per-s / ``T_eff`` instrumentation in every model's run loop, named
 profiler annotations on the pipelined ring/interior passes and the slab
 exchange, and `telemetry_snapshot` / `dump_metrics` (JSON + Prometheus
-text) as the public surface.  ``IGG_TELEMETRY=0`` disables it all on a
+text) as the public surface.  On top: the cross-rank observability plane
+(`utils.tracing`) — host spans (`trace_span`) into a bounded ring,
+per-rank trace dumps (`dump_trace`) mergeable into ONE barrier-aligned
+Chrome/Perfetto timeline (``scripts/igg_trace.py``), an all-ranks
+straggler probe at heartbeat cadence (``skew.*`` gauges), and a crash
+flight recorder (``flight_<rank>.json``) dumped on guard trips, watchdog
+deadlines and injected crashes.  ``IGG_TELEMETRY=0`` disables it all on a
 zero-allocation branch.
 
 Static analysis (docs/static-analysis.md): ``igg.analysis`` — a pass
@@ -90,7 +96,9 @@ from .utils.checkpoint import (
     verify_checkpoint,
 )
 from .utils import telemetry
+from .utils import tracing
 from .utils.telemetry import dump_metrics, telemetry_snapshot
+from .utils.tracing import dump_trace, trace_span
 from . import analysis
 
 __version__ = "0.1.0"
@@ -150,6 +158,9 @@ __all__ = [
     "telemetry",
     "telemetry_snapshot",
     "dump_metrics",
+    "tracing",
+    "trace_span",
+    "dump_trace",
     # static-analysis subsystem (docs/static-analysis.md)
     "analysis",
     # batched multi-simulation serving (ISSUE 8; docs/api.md)

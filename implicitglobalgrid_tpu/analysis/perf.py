@@ -132,6 +132,33 @@ def gate_metrics(record: dict) -> dict:
     return out
 
 
+#: extras keys REPORTED alongside the gate verdict but not (yet) gated:
+#: ``achieved_fraction`` is the cost-model reconciliation number
+#: (`analysis.reconcile` — ``extras.efficiency``), carried per round so a
+#: future gate has a trajectory to regress against before it starts
+#: failing PRs on it.
+REPORTED_KEYS = ("achieved_fraction",)
+
+
+def reported_metrics(record: dict) -> dict:
+    """Flatten one bench record to ``{metric path: value}`` for the
+    report-only keys (`REPORTED_KEYS`) — same walk as `gate_metrics`,
+    no comparison semantics."""
+    out = {}
+
+    def walk(prefix: str, node) -> None:
+        if not isinstance(node, dict):
+            return
+        for key, val in node.items():
+            if key in REPORTED_KEYS and isinstance(val, (int, float)):
+                out[f"{prefix}{key}"] = float(val)
+            elif isinstance(val, dict):
+                walk(f"{prefix}{key}.", val)
+
+    walk("", record.get("extras", {}))
+    return out
+
+
 # -- waivers ------------------------------------------------------------------
 
 
@@ -251,6 +278,7 @@ def gate_summary(candidate_record: dict, repo_root: str, *,
         "reference_round": ref_round,
         "tol": tol,
         **cmp,
+        "reported": reported_metrics(candidate_record),
         "skipped_records": skipped,
     }
 

@@ -1,0 +1,232 @@
+"""Cost-model reconciliation report: achieved vs modeled traffic.
+
+The static HLO cost model (``hlo-cost``, `analysis.costmodel`) pins what
+each production program MOVES — ``bytes_accessed`` per compiled multi-step
+cadence — and the bench records pin what the hardware ACHIEVED — the
+``T_eff`` GB/s convention, which counts only the must-stream bytes of the
+evolving state.  Until this module nothing joined the two: a bench round
+could report a flattering T_eff while the compiled program quietly moved 3x
+the mandatory bytes, and nobody would see the gap.
+
+The join is one number per model (docs/performance.md):
+
+    achieved_fraction = must_stream_bytes * iterations / bytes_accessed
+
+the fraction of the program's *modeled* HBM traffic that the T_eff
+convention counts as algorithmically mandatory.  1.0 means the compiled
+cadence streams nothing beyond the convention; every extra copy, halo
+recompute pass or materialized intermediate pulls it down.  It is also the
+exact conversion factor between the two measurement worlds: a measured
+``T_eff`` of X GB/s implies the hardware sustained ``X / achieved_fraction``
+GB/s of modeled traffic (`join_measured` attaches both to a bench record).
+
+Conventions mirror ``benchmarks/run.py`` (the numbers must reconcile
+against ITS records): diffusion streams T in+out per step; acoustic streams
+P, Vx, Vy, Vz per step; porous streams Pf, qDx, qDy, qDz in+out per PT
+iteration (``iterations = nt * npt`` — the PT solver's inner loop is the
+unit the porous bench times).  The per-program bytes come either from the
+committed ``analysis/cost_baseline.json`` (``source="baseline"`` — fast,
+no compile, exactly the audited numbers tier-1 gates on) or from a fresh
+XLA:CPU compile of the same `ir.COMPILED_MATRIX` cadence programs
+(``source="compiled"`` — what ``bench.py`` records via the
+``benchmarks/run.py reconcile`` mode).
+
+Caveat recorded in every report: the fraction is computed at the cadence
+matrix's config (small blocks, 2-device mesh), where halo-adjacent
+redundancy weighs MORE than at bench sizes — treat it as a conservative
+floor when joining against large-grid teff measurements (``sizes`` in the
+report name both configs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import ir
+
+#: models covered (keys of `ir._CADENCES` / `ir._MODEL_MODULES`)
+MODELS = ("diffusion", "acoustic", "porous")
+
+#: per-model must-stream state slice, in the model's state-tuple order —
+#: the benchmarks/run.py T_eff conventions (see module docstring).
+_STREAM_SLICES = {
+    "diffusion": slice(0, 1),   # T
+    "acoustic": slice(0, 4),    # P, Vx, Vy, Vz
+    "porous": slice(1, 5),      # Pf, qDx, qDy, qDz (per PT iteration)
+}
+
+
+def cadence_program(model: str) -> str:
+    return f"cadence/{model}[pipelined=True]"
+
+
+def model_iterations(model: str) -> int:
+    """Streaming iterations of one compiled cadence program: ``nt`` steps,
+    times ``npt`` inner PT iterations for porous (the unit its bench
+    times)."""
+    cfg = dict(ir._CADENCES)[model]
+    return int(cfg["nt"]) * int(cfg.get("npt", 1))
+
+
+def model_stream_bytes(model: str) -> dict:
+    """Must-stream bytes per iteration of one cadence config.
+
+    Sets up the model on the SAME grid as the cadence matrix
+    (`ir._cadence_setup_kwargs` — 2-device x-split, f32) so the byte count
+    is taken from the actual global field shapes (staggered +1 faces
+    included), then tears the grid down.  Returns ``{stream_bytes,
+    global_shape, dtype, fields}``.
+    """
+    import importlib
+
+    import implicitglobalgrid_tpu as igg
+    from ..utils.telemetry import teff_bytes
+
+    cfg = dict(ir._CADENCES)[model]
+    mod = importlib.import_module(
+        "implicitglobalgrid_tpu.models." + ir._MODEL_MODULES[model]
+    )
+    state, _params = mod.setup(*cfg["nloc"], **ir._cadence_setup_kwargs(cfg))
+    try:
+        fields = state[_STREAM_SLICES[model]]
+        sb = teff_bytes(fields)
+        gg = igg.get_global_grid()
+        info = {
+            "stream_bytes": int(sb),
+            "global_shape": list(gg.nxyz_g),
+            "dtype": str(fields[0].dtype),
+            "fields": len(fields),
+        }
+    finally:
+        if igg.grid_is_initialized():
+            igg.finalize_global_grid()
+    return info
+
+
+def _program_costs(source: str) -> dict:
+    """``{model: {"bytes_accessed", "flops"}}`` for the cadence programs.
+
+    ``source="baseline"`` reads the committed `costmodel.COST_BASELINE`
+    (the audited numbers); ``source="compiled"`` compiles each cadence
+    fresh on XLA:CPU (`ir.compile_program`).
+    """
+    out = {}
+    if source == "baseline":
+        from .costmodel import load_baseline
+
+        programs = load_baseline().get("programs", {})
+        for model in MODELS:
+            metrics = programs.get(cadence_program(model), {}).get(
+                "metrics", {}
+            )
+            out[model] = {
+                "bytes_accessed": metrics.get("bytes_accessed"),
+                "flops": metrics.get("flops"),
+            }
+    elif source == "compiled":
+        for model in MODELS:
+            prog = ir.compile_program(cadence_program(model))
+            out[model] = {
+                "bytes_accessed": prog.cost.get("bytes_accessed"),
+                "flops": prog.cost.get("flops"),
+            }
+    else:
+        raise ValueError(
+            f"source must be 'baseline' or 'compiled', got {source!r}"
+        )
+    return out
+
+
+def reconcile_report(*, source: str = "baseline") -> dict:
+    """The achieved-vs-modeled report for all three models.
+
+    Per model: the cadence program's modeled ``bytes_accessed``/``flops``,
+    the must-stream bytes of its config, and ``achieved_fraction`` (module
+    docstring).  A model whose cost numbers are unavailable (toolchain
+    without cost analysis, baseline entry missing) reports
+    ``achieved_fraction: None`` with the reason — absence must be visible,
+    not a silent skip.
+    """
+    costs = _program_costs(source)
+    models = {}
+    for model in MODELS:
+        iters = model_iterations(model)
+        stream = model_stream_bytes(model)
+        rec = {
+            "program": cadence_program(model),
+            "iterations": iters,
+            **stream,
+            **costs[model],
+        }
+        ba = costs[model].get("bytes_accessed")
+        if ba:
+            rec["modeled_bytes_per_iteration"] = float(ba) / iters
+            rec["achieved_fraction"] = round(
+                stream["stream_bytes"] * iters / float(ba), 6
+            )
+        else:
+            rec["achieved_fraction"] = None
+            rec["note"] = (
+                f"no bytes_accessed available from source={source!r} for "
+                f"{cadence_program(model)}"
+            )
+        models[model] = rec
+    return {
+        "source": source,
+        "note": (
+            "achieved_fraction = must-stream bytes / modeled bytes_accessed "
+            "of the cadence-matrix config (small 2-device blocks: halo "
+            "redundancy weighs more than at bench sizes — a conservative "
+            "floor); measured_teff / achieved_fraction = implied modeled "
+            "GB/s the hardware sustained"
+        ),
+        "models": models,
+    }
+
+
+def join_measured(report: dict, measured_teff_gbs: dict) -> dict:
+    """Attach measured ``T_eff`` values (``{model: GB/s}``) to a report.
+
+    Adds ``measured_teff_gbs`` and ``modeled_actual_gbs`` (= measured /
+    achieved_fraction — the modeled total-traffic rate that measurement
+    implies) per model; models without a measurement or a fraction pass
+    through unchanged.  This is the `efficiency` extra ``bench.py``
+    attaches to every record.
+    """
+    out = {"source": report.get("source"), "note": report.get("note"),
+           "models": {}}
+    for model, rec in report.get("models", {}).items():
+        rec = dict(rec)
+        teff = measured_teff_gbs.get(model)
+        frac = rec.get("achieved_fraction")
+        if teff is not None:
+            rec["measured_teff_gbs"] = float(teff)
+            if frac:
+                rec["modeled_actual_gbs"] = round(float(teff) / frac, 3)
+        out["models"][model] = rec
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: print the report as one JSON line (the ``benchmarks/run.py
+    reconcile`` mode shells out here on the CPU mesh)."""
+    import argparse
+
+    from .core import ensure_cpu_devices
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--source", choices=("baseline", "compiled"), default="compiled",
+        help="baseline: the committed cost_baseline.json numbers (no "
+             "compile); compiled: fresh XLA:CPU compiles of the cadence "
+             "matrix (default)",
+    )
+    args = ap.parse_args(argv)
+    ensure_cpu_devices()
+    print(json.dumps(reconcile_report(source=args.source)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

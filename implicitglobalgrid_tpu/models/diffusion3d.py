@@ -703,8 +703,13 @@ def run(
 
     caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
     try:
-        state, params = setup(nx, ny, nz, **setup_kwargs)
-        step = make_step(params)
+        from ..utils import tracing as _tracing
+
+        # Setup span: grid bring-up + field allocation, distinct from the
+        # per-step `igg.step` spans the loop records (docs/observability.md).
+        with _tracing.trace_span("igg.run.setup", model="diffusion3d"):
+            state, params = setup(nx, ny, nz, **setup_kwargs)
+            step = make_step(params)
         guard = RunGuard(
             guard_every=guard_every,
             policy=guard_policy,

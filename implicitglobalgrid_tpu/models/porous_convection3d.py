@@ -896,8 +896,11 @@ def run(
 
     caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
     try:
-        state, params = setup(nx, ny, nz, **kw)
-        step = make_step(params)
+        from ..utils import tracing as _tracing
+
+        with _tracing.trace_span("igg.run.setup", model="porous_convection3d"):
+            state, params = setup(nx, ny, nz, **kw)
+            step = make_step(params)
         guard = RunGuard(
             guard_every=guard_every,
             policy=guard_policy,

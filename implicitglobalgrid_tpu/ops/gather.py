@@ -402,6 +402,24 @@ def gather(
     member: int | None = None,
     _force_chunked: bool = False,
 ):
+    from ..utils import tracing as _tracing
+
+    with _tracing.trace_span("igg.gather", root=root, dedup=dedup):
+        return _gather(
+            A, A_global, root=root, dedup=dedup, member=member,
+            _force_chunked=_force_chunked,
+        )
+
+
+def _gather(
+    A,
+    A_global=None,
+    *,
+    root: int = 0,
+    dedup: bool = False,
+    member: int | None = None,
+    _force_chunked: bool = False,
+):
     """Gather field ``A`` to the host on process ``root``.
 
     ``member=k`` gathers ONE ensemble member of a BATCHED field (leading
@@ -562,6 +580,11 @@ def gather(
         np.copyto(A_global.reshape(data.shape), data)
         return None
     return data
+
+
+# The public entry wraps the implementation in the ``igg.gather`` host span
+# (docs/observability.md); same docstring, same collective contract.
+gather.__doc__ = _gather.__doc__
 
 
 def _check_out(A_global, size: int, dtype) -> None:

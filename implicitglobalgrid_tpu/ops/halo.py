@@ -933,6 +933,7 @@ def begin_slab_exchange(fields, dims, *, width: int, logicals=None,
     per-field values.  Traced-context only, like `exchange_dims`.
     """
     from ..utils import telemetry as _telemetry
+    from ..utils import tracing as _tracing
     from ..utils.compat import named_scope
 
     gg = _grid.global_grid()
@@ -942,11 +943,15 @@ def begin_slab_exchange(fields, dims, *, width: int, logicals=None,
         coalesce = _default_coalesce()
     # Trace-time counter: begin/finish calls run while BUILDING a program
     # (the early-dispatch exchange shape), so this counts traced schedules,
-    # not runtime executions (docs/observability.md).
+    # not runtime executions (docs/observability.md).  The host span below
+    # is trace-time too (tagged so a timeline reader cannot mistake it for
+    # a runtime exchange).
     _telemetry.counter("halo.begin_slab_traces").inc()
     receiveds: list[dict] = [{} for _ in fields]
     pends: list[list] = [[] for _ in fields]
-    with named_scope("igg_slab_exchange_begin"):
+    with _tracing.trace_span(
+        "igg_slab_exchange_begin", phase="trace", fields=len(fields)
+    ), named_scope("igg_slab_exchange_begin"):
         for d in dims:
             vals = _multi_slab_recv_values(
                 fields, d, gg, width, logicals, receiveds=receiveds,
@@ -969,13 +974,16 @@ def finish_slab_exchange(fields, pends, *, logicals=None):
     updated tuple.
     """
     from ..utils import telemetry as _telemetry
+    from ..utils import tracing as _tracing
     from ..utils.compat import named_scope
 
     if logicals is None:
         logicals = (None,) * len(fields)
     _telemetry.counter("halo.finish_slab_traces").inc()
     out = []
-    with named_scope("igg_slab_exchange_finish"):
+    with _tracing.trace_span(
+        "igg_slab_exchange_finish", phase="trace", fields=len(fields)
+    ), named_scope("igg_slab_exchange_finish"):
         for A, pend, logical in zip(fields, pends, logicals):
             shp = logical if logical is not None else tuple(A.shape)
             for d, lo, hi in pend:
@@ -1378,6 +1386,7 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None,
         if coalesce is None:
             coalesce = _default_coalesce()
         from ..utils import telemetry as _telemetry
+        from ..utils import tracing as _tracing
 
         if _telemetry.enabled():
             # Runtime counters (the global-array entry runs host-side per
@@ -1387,7 +1396,15 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None,
             _telemetry.counter("halo.fields").inc(len(arrs))
             _telemetry.counter("halo.bytes").inc(nbytes)
             _telemetry.histogram("halo.slab_bytes").record(nbytes)
-        out = _global_update_fn(gg, sig, width, bool(donate), bool(coalesce))(*arrs)
+        # Host span named like the device-side annotation
+        # (`named_scope("igg_halo_exchange")` inside the compiled program),
+        # so the merged trace and a profiler capture correlate by name.
+        with _tracing.trace_span(
+            "igg_halo_exchange", fields=len(arrs), width=width
+        ):
+            out = _global_update_fn(
+                gg, sig, width, bool(donate), bool(coalesce)
+            )(*arrs)
         if _post_exchange_hook is not None:
             out = tuple(_post_exchange_hook(tuple(out)))
     return out[0] if len(fields) == 1 else tuple(out)

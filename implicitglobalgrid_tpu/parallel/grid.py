@@ -319,10 +319,20 @@ def init_global_grid(
     # Python tracebacks see nothing — the IGG_WATCHDOG_S watchdog dumps
     # all-thread stacks (and the env tier keeps it out of the hot loop).
     from ..utils import config as _cfg
+    from ..utils import tracing as _tracing
     from ..utils.resilience import watchdog as _watchdog
 
     with _watchdog(_cfg.watchdog_env()):
         init_timing_functions()
+        # Cross-rank clock sync (docs/observability.md): one more barrier,
+        # with every rank's wall/perf clocks sampled right at its exit —
+        # the shared instant `igg.dump_trace` merges per-rank timelines on.
+        # The recorded uncertainty is the measured barrier duration (the
+        # honest bound on cross-rank alignment).  Single process: no
+        # barrier needed, the one local clock aligns with itself.
+        _tracing.record_clock_sync(
+            _barrier if jax.process_count() > 1 else None, epoch=_epoch
+        )
     return me, dims, nprocs, coords, mesh
 
 
@@ -346,12 +356,14 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
     from ..utils import resilience as _resilience
+    from ..utils import tracing as _tracing
 
     _halo._clear_caches()
     _stencil._clear_caches()
     _gather._clear_caches()
     _resilience._clear_caches()
     _batched_mod._clear_caches()
+    _tracing._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
     if finalize_distributed:

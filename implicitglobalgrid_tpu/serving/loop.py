@@ -32,13 +32,19 @@ Telemetry (docs/observability.md): gauges ``serving.active_members``,
 ``serving.queue_depth``; counters ``serving.admitted_total``,
 ``serving.retired_total``, ``serving.converged_total``,
 ``serving.evicted_total``, ``serving.rollbacks_total``,
-``serving.rounds``, ``serving.tenant.<tenant>.steps``; histogram
-``serving.member_t_eff_gbs`` (per-member T_eff: the member's must-stream
-bytes over the round wall time — every member of a round shares the wall
-time, which is the point of batching).  Events: ``serving.admit`` /
-``serving.retire`` / ``serving.converged`` / ``serving.evict`` /
-``serving.rollback``, each tagged with member id, slot, tenant and step
-count.
+``serving.rounds``, ``serving.tenant.<tenant>.steps`` (cardinality-capped
+via `telemetry.tenant_counter`: past ``IGG_TELEMETRY_MAX_TENANTS``
+distinct tenants, overflow folds into ``serving.tenant.__other__.steps``
+— tenant strings arrive from requests, so the series count must be
+bounded); histogram ``serving.member_t_eff_gbs`` (per-member T_eff: the
+member's must-stream bytes over the round wall time — every member of a
+round shares the wall time, which is the point of batching).  Events:
+``serving.admit`` / ``serving.retire`` / ``serving.converged`` /
+``serving.evict`` / ``serving.rollback``, each tagged with member id,
+slot, tenant and step count.  Each round runs inside an
+``igg.serving.round`` host span (member/slot/tenant-tagged) and, at the
+``IGG_HEARTBEAT_EVERY`` round cadence on multi-process grids, drives the
+all-ranks skew probe (`utils.tracing.skew_probe`).
 """
 
 from __future__ import annotations
@@ -52,6 +58,7 @@ import numpy as np
 from ..models import _batched
 from ..utils import config as _config
 from ..utils import telemetry as _telemetry
+from ..utils import tracing as _tracing
 
 #: Per-model serving adapter: state field names and which fields the
 #: per-member T_eff bytes model counts (`telemetry.teff_bytes` convention),
@@ -345,54 +352,85 @@ class ServingLoop:
     # -- the round ------------------------------------------------------------
 
     def run_round(self) -> None:
-        """One serving round: step active members, guard, retire, admit."""
+        """One serving round: step active members, guard, retire, admit.
+
+        The round is wrapped in an ``igg.serving.round`` host span tagged
+        with the active (member, slot, tenant) triples, and — at the
+        ``IGG_HEARTBEAT_EVERY`` round cadence on multi-process grids —
+        runs the all-ranks skew probe over the round wall time
+        (`utils.tracing.skew_probe`; every rank drives the identical
+        round sequence, so the probe's collective cadence agrees by
+        construction).
+        """
         self._admit_from_queue()
         mask = self._mask()
-        if self._state is not None and mask.any():
-            t0 = time.perf_counter()
-            new = self._step(*self._state)
-            # Masking AFTER the step bit-freezes non-running members; the
-            # step itself ran every slot (that is what batching means — the
-            # flops of idle slots are the price of the shared program).
-            self._state = _batched.select_members(mask, new, self._state)
-            import jax
+        members = [
+            {"member": s.member, "slot": k, "tenant": s.tenant}
+            for k, s in enumerate(self.slots)
+            if s.active
+        ]
+        with _tracing.trace_span(
+            "igg.serving.round", round=self.rounds, members=members,
+            queued=len(self.queue),
+        ):
+            dt = 0.0
+            if self._state is not None and mask.any():
+                t0 = time.perf_counter()
+                new = self._step(*self._state)
+                # Masking AFTER the step bit-freezes non-running members;
+                # the step itself ran every slot (that is what batching
+                # means — the flops of idle slots are the price of the
+                # shared program).
+                self._state = _batched.select_members(mask, new, self._state)
+                import jax
 
-            jax.block_until_ready(self._state)
-            dt = time.perf_counter() - t0
-            for k, slot in enumerate(self.slots):
-                if slot.active:
-                    slot.steps += self.steps_per_round
-                    _telemetry.counter(
-                        f"serving.tenant.{slot.tenant}.steps"
-                    ).inc(self.steps_per_round)
-            if dt > 0:
-                from ..utils.telemetry import teff_bytes
-
-                member_bytes = teff_bytes(
-                    self._blank[self.info["stream"]]
-                ) * self.steps_per_round
-                gbs = member_bytes / dt / 1e9
+                jax.block_until_ready(self._state)
+                dt = time.perf_counter() - t0
                 for k, slot in enumerate(self.slots):
                     if slot.active:
-                        _telemetry.histogram(
-                            "serving.member_t_eff_gbs"
-                        ).record(gbs)
-            self._guard(mask)
-            self._convergence()
-        # Step-budget retirement (after guard: never hand back unguarded
-        # state) and back-fill from the queue.
-        for k, slot in enumerate(self.slots):
-            if slot.active and slot.steps >= slot.max_steps:
-                self._retire(k, "completed")
-        self.rounds += 1
-        _telemetry.counter("serving.rounds").inc()
-        if (
-            self.checkpoint_every
-            and self.rounds % self.checkpoint_every == 0
-            and self._state is not None
-        ):
-            self._save_checkpoint()
-        self._admit_from_queue()
+                        slot.steps += self.steps_per_round
+                        # Cardinality-capped per-tenant attribution: tenant
+                        # strings come from requests, so the series count
+                        # must be bounded (IGG_TELEMETRY_MAX_TENANTS).
+                        _telemetry.tenant_counter(slot.tenant).inc(
+                            self.steps_per_round
+                        )
+                if dt > 0:
+                    from ..utils.telemetry import teff_bytes
+
+                    member_bytes = teff_bytes(
+                        self._blank[self.info["stream"]]
+                    ) * self.steps_per_round
+                    gbs = member_bytes / dt / 1e9
+                    for k, slot in enumerate(self.slots):
+                        if slot.active:
+                            _telemetry.histogram(
+                                "serving.member_t_eff_gbs"
+                            ).record(gbs)
+                self._guard(mask)
+                self._convergence()
+            # Step-budget retirement (after guard: never hand back unguarded
+            # state) and back-fill from the queue.
+            for k, slot in enumerate(self.slots):
+                if slot.active and slot.steps >= slot.max_steps:
+                    self._retire(k, "completed")
+            self.rounds += 1
+            _telemetry.counter("serving.rounds").inc()
+            if _telemetry.enabled():
+                hb = _config.heartbeat_every_env() or 0
+                # The gate must be rank-uniform (the probe is a collective):
+                # rounds and mask derive from the deterministic admit/retire
+                # sequence every rank drives identically — never from a
+                # locally measured time.
+                if hb and self.rounds % hb == 0 and mask.any():
+                    _tracing.skew_probe(dt / self.steps_per_round)
+            if (
+                self.checkpoint_every
+                and self.rounds % self.checkpoint_every == 0
+                and self._state is not None
+            ):
+                self._save_checkpoint()
+            self._admit_from_queue()
 
     def _guard(self, mask: np.ndarray) -> None:
         if self.guard_policy == "off":

@@ -61,6 +61,7 @@ import numpy as np
 from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 FORMAT_VERSION = 2
 #: formats this build can restore (1 = pre-manifest, no integrity data)
@@ -127,6 +128,17 @@ _MATCH_KEYS = ("dims", "nxyz", "nxyz_g", "overlaps", "periods", "disp", "nprocs"
 
 
 def save_checkpoint(
+    directory: str | os.PathLike,
+    state: Sequence,
+    step: int,
+    *,
+    extra: dict | None = None,
+) -> str:
+    with _tracing.trace_span("igg.checkpoint.save", step=step):
+        return _save_checkpoint(directory, state, step, extra=extra)
+
+
+def _save_checkpoint(
     directory: str | os.PathLike,
     state: Sequence,
     step: int,
@@ -267,6 +279,11 @@ def save_checkpoint(
     return step_dir
 
 
+# The public entry wraps the implementation in the ``igg.checkpoint.save``
+# host span (docs/observability.md); same docstring, same contract.
+save_checkpoint.__doc__ = _save_checkpoint.__doc__
+
+
 def checkpoint_steps(directory: str | os.PathLike) -> list[tuple[int, str]]:
     """All published checkpoint generations under ``directory``, sorted by
     step ascending, as ``(step, path)`` pairs.  Published = the ``step_*``
@@ -383,6 +400,18 @@ def restore_checkpoint(
     strict: bool = False,
     verify: bool = True,
 ) -> tuple[tuple, int, dict]:
+    with _tracing.trace_span("igg.checkpoint.restore", path=os.fspath(path)):
+        return _restore_checkpoint(path, like=like, strict=strict,
+                                   verify=verify)
+
+
+def _restore_checkpoint(
+    path: str | os.PathLike,
+    *,
+    like: Sequence | None = None,
+    strict: bool = False,
+    verify: bool = True,
+) -> tuple[tuple, int, dict]:
     """Restore ``(state, step, extra)`` from a checkpoint directory.
 
     Requires an initialized grid.  When the current topology matches the
@@ -471,6 +500,9 @@ def restore_checkpoint(
             f"({shard_path}); it was written by a different process layout."
         )
     return _restore_elastic(path, meta, gg, like)
+
+
+restore_checkpoint.__doc__ = _restore_checkpoint.__doc__
 
 
 def _restore_same_topology(path, meta, gg, like):

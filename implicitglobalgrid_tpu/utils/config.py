@@ -86,7 +86,21 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           metrics-registry-only, no files written
 ``IGG_HEARTBEAT_EVERY``   rank-0 heartbeat cadence in steps for the models'
                           instrumented run loops (int >= 0; 0/unset = off):
-                          every N steps print step time, steps/s and T_eff
+                          every N steps print step time, steps/s and T_eff —
+                          and, on multi-process grids, run the all-ranks
+                          skew probe (`utils.tracing.skew_probe`)
+``IGG_TRACE_RING``        capacity of the per-process host-span ring buffer
+                          (`utils.tracing`; int >= 0, default 4096; 0
+                          disables span recording entirely) — read per
+                          span, like ``IGG_TELEMETRY``
+``IGG_SKEW_WARN``         straggler threshold for the all-ranks skew probe
+                          (number >= 0, default 2.0): a ``skew.straggler``
+                          event fires when max/min per-rank step wall time
+                          exceeds it; 0 disables the event (gauges still
+                          publish)
+``IGG_TELEMETRY_MAX_TENANTS``  cap on distinct ``serving.tenant.<t>.steps``
+                          counter series (int >= 1, default 64); overflow
+                          tenants fold into ``serving.tenant.__other__.steps``
 ========================  ====================================================
 
 Explicit kwargs always win over env values; env values win over built-in
@@ -325,3 +339,21 @@ def heartbeat_every_env() -> int | None:
     """``IGG_HEARTBEAT_EVERY``: rank-0 heartbeat cadence in steps (>= 0;
     0 = off)."""
     return _int_env("IGG_HEARTBEAT_EVERY", minimum=0)
+
+
+def trace_ring_env() -> int | None:
+    """``IGG_TRACE_RING``: per-process span ring-buffer capacity (>= 0;
+    0 disables span recording; unset = the `utils.tracing` default)."""
+    return _int_env("IGG_TRACE_RING", minimum=0)
+
+
+def skew_warn_env() -> float | None:
+    """``IGG_SKEW_WARN``: straggler event threshold on max/min per-rank
+    step wall time (>= 0; 0 disables the event, gauges still publish)."""
+    return _float_env("IGG_SKEW_WARN", minimum=0)
+
+
+def telemetry_max_tenants_env() -> int | None:
+    """``IGG_TELEMETRY_MAX_TENANTS``: cap on distinct per-tenant counter
+    series (>= 1); overflow folds into ``serving.tenant.__other__.steps``."""
+    return _int_env("IGG_TELEMETRY_MAX_TENANTS", minimum=1)

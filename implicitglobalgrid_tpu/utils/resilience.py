@@ -47,6 +47,7 @@ from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES, NDIMS
 from . import config as _config
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = [
     "GuardError",
@@ -272,6 +273,14 @@ def watchdog(timeout_s: float | None, *, exit: bool = False, file=None):
                 elapsed_s=elapsed,
             )
             _telemetry.counter("resilience.watchdog_deadline_exceeded").inc()
+            # Flight-recorder bundle (docs/observability.md): a blown
+            # watchdog deadline is exactly the moment an operator needs
+            # the span ring + metrics + config of this rank on disk.
+            _tracing.dump_flight_recorder(
+                "watchdog.deadline_exceeded",
+                timeout_s=timeout_s,
+                elapsed_s=elapsed,
+            )
 
 
 # -- Numerical guards ---------------------------------------------------------
@@ -626,6 +635,11 @@ class FaultInjector:
         _telemetry.event(
             "fault.worker_crash", step=step, status=self.CRASH_STATUS
         )
+        # Same discipline for the flight bundle: one complete line on disk
+        # BEFORE the hard exit (the soak drill verifies it exists).
+        _tracing.dump_flight_recorder(
+            "fault.worker_crash", step=step, status=self.CRASH_STATUS
+        )
         print(
             f"[igg.resilience] IGG_FAULT_INJECT(worker_crash): exiting hard "
             f"after step {step} (status {self.CRASH_STATUS})",
@@ -801,6 +815,10 @@ def install_halo_fault_hook() -> None:
 
 _copy_jit = None
 
+#: shared reusable null context for the untraced step pipeline
+#: (`contextlib.nullcontext` instances are stateless and re-enterable)
+_NULL_CM = contextlib.nullcontext()
+
 
 def snapshot_state(state: tuple) -> tuple:
     """Device-side bit-exact copy of a state tuple (fresh buffers).
@@ -865,16 +883,25 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard",
             stacklevel=2,
         )
     while it < nt:
+        # The ``igg.step`` host span (docs/observability.md): one span per
+        # loop iteration — dispatch + sync + guard pipeline, the same wall
+        # time the step_seconds histogram records — tagged so a merged
+        # cross-rank trace aligns steps BY NUMBER; the profiler annotation
+        # rides along for on-device captures.  Untraced loops reuse the
+        # shared null managers (the zero-allocation contract).
         if tele is None:
-            state = step_fn(*state)
+            span = ann = _NULL_CM
         else:
-            with trace_annotation(f"igg_step[{model}]"):
+            span = _tracing.trace_span("igg.step", model=model, step=it + 1)
+            ann = trace_annotation(f"igg_step[{model}]")
+        with span:
+            with ann:
                 state = step_fn(*state)
-        if sync_every_step:
-            jax.block_until_ready(state)
-        it += 1
-        if enabled:
-            state, it = guard.on_step(state, it)
+            if sync_every_step:
+                jax.block_until_ready(state)
+            it += 1
+            if enabled:
+                state, it = guard.on_step(state, it)
         if tele is not None:
             tele.on_step(it)
     if tele is not None:
@@ -1041,6 +1068,10 @@ class RunGuard:
             "guard.trip", step=it, policy=self.policy, report=report.summary()
         )
         _telemetry.counter("resilience.guard_trips").inc()
+        _tracing.dump_flight_recorder(
+            "guard.trip", step=it, policy=self.policy,
+            report=report.summary(),
+        )
         if self.policy == "raise":
             raise GuardError(msg, step=it, report=report)
         if self.policy == "warn":

@@ -54,6 +54,7 @@ __all__ = [
     "counter",
     "gauge",
     "histogram",
+    "tenant_counter",
     "event",
     "snapshot",
     "telemetry_snapshot",
@@ -213,6 +214,15 @@ def gauge(name: str) -> Gauge | _Noop:
         return m
 
 
+def gauge_value(name: str) -> float | None:
+    """Current value of a gauge IF it exists (never creates one) — the
+    read side the enriched heartbeat uses to attach skew/serving context
+    only when something actually published it."""
+    with _lock:
+        g = _gauges.get(name)
+        return g.value if g is not None else None
+
+
 def histogram(name: str) -> Histogram | _Noop:
     if not enabled():
         return NOOP
@@ -220,6 +230,49 @@ def histogram(name: str) -> Histogram | _Noop:
         m = _histograms.get(name)
         if m is None:
             m = _histograms[name] = Histogram(name)
+        return m
+
+
+#: default ``IGG_TELEMETRY_MAX_TENANTS`` (distinct per-tenant series)
+MAX_TENANTS_DEFAULT = 64
+
+#: the fold-over series once the tenant cap is hit
+TENANT_OVERFLOW = "serving.tenant.__other__.steps"
+
+_TENANT_PREFIX, _TENANT_SUFFIX = "serving.tenant.", ".steps"
+
+
+def tenant_counter(tenant: str) -> Counter | _Noop:
+    """The ``serving.tenant.<tenant>.steps`` counter, cardinality-capped.
+
+    Tenant strings arrive from REQUESTS, so an uncapped per-tenant series
+    is an unbounded-memory hole (every distinct string a counter, forever).
+    At most ``IGG_TELEMETRY_MAX_TENANTS`` (default `MAX_TENANTS_DEFAULT`)
+    distinct tenant series are created; once the cap is reached, new
+    tenants fold into the shared `TENANT_OVERFLOW` series (existing
+    tenants keep their own).  The total step count across the family is
+    exact either way — only per-tenant attribution degrades past the cap.
+    """
+    if not enabled():
+        return NOOP
+    name = f"{_TENANT_PREFIX}{tenant}{_TENANT_SUFFIX}"
+    with _lock:
+        m = _counters.get(name)
+        if m is None:
+            env = _config.telemetry_max_tenants_env()
+            cap = MAX_TENANTS_DEFAULT if env is None else env
+            distinct = sum(
+                1
+                for k in _counters
+                if k.startswith(_TENANT_PREFIX)
+                and k.endswith(_TENANT_SUFFIX)
+                and k != TENANT_OVERFLOW
+            )
+            if name != TENANT_OVERFLOW and distinct >= cap:
+                name = TENANT_OVERFLOW
+                m = _counters.get(name)
+            if m is None:
+                m = _counters[name] = Counter(name)
         return m
 
 
@@ -475,6 +528,10 @@ class _StepLoop:
         self._teff = histogram(f"{model}.t_eff_gbs") if bytes_per_step else None
         self._teff_g = gauge(f"{model}.t_eff_gbs_last") if bytes_per_step else None
         self._t_last = time.perf_counter()
+        # last-window accumulator for the all-ranks skew probe (one window
+        # per heartbeat interval; docs/observability.md straggler section)
+        self._win_sum = 0.0
+        self._win_n = 0
         event("run.start", model=model, start_step=start_step,
               total_steps=total_steps, bytes_per_step=bytes_per_step)
 
@@ -485,6 +542,8 @@ class _StepLoop:
         self._t_last = now
         self._steps.inc()
         self._step_s.record(dt)
+        self._win_sum += dt
+        self._win_n += 1
         if dt > 0:
             self._sps.set(1.0 / dt)
         gbs = None
@@ -492,26 +551,66 @@ class _StepLoop:
             gbs = self.bytes_per_step / dt / 1e9
             self._teff.record(gbs)
             self._teff_g.set(gbs)
-        if (
-            self.heartbeat_every
-            and self._is_rank0
-            and it % self.heartbeat_every == 0
-        ):
-            import sys
+        if self.heartbeat_every and it % self.heartbeat_every == 0:
+            # The skew probe is a COLLECTIVE: every rank must run it at the
+            # same step (hence outside the rank-0 gate below; single-process
+            # grids return None without touching any transport).
+            skew = None
+            if self._win_n:
+                from . import tracing as _tracing
 
-            teff_s = f" T_eff {gbs:.2f} GB/s" if gbs is not None else ""
-            print(
-                f"[igg.telemetry] {self.model} step {it}/{self.total_steps} "
-                f"{dt * 1e3:.2f} ms/step {1.0 / dt if dt > 0 else 0.0:.1f} "
-                f"steps/s{teff_s}",
-                file=sys.stderr,
-                flush=True,
-            )
-            event("heartbeat", model=self.model, step=it,
-                  step_seconds=dt, t_eff_gbs=gbs)
+                skew = _tracing.skew_probe(self._win_sum / self._win_n)
+            self._win_sum = 0.0
+            self._win_n = 0
+            if self._is_rank0:
+                import sys
+
+                teff_s = f" T_eff {gbs:.2f} GB/s" if gbs is not None else ""
+                skew_s = (
+                    f" skew {skew['ratio']:.2f} (slowest rank "
+                    f"{skew['slowest_rank']})" if skew else ""
+                )
+                print(
+                    f"[igg.telemetry] {self.model} step {it}/"
+                    f"{self.total_steps} "
+                    f"{dt * 1e3:.2f} ms/step {1.0 / dt if dt > 0 else 0.0:.1f} "
+                    f"steps/s{teff_s}{skew_s}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                event("heartbeat", model=self.model, step=it,
+                      step_seconds=dt, t_eff_gbs=gbs,
+                      **_heartbeat_context(skew))
 
     def finish(self, it: int) -> None:
         event("run.complete", model=self.model, step=it)
+
+
+def _heartbeat_context(skew: dict | None) -> dict:
+    """The heartbeat event's extended context (docs/observability.md):
+    the current skew gauges (fresh probe result preferred, else the last
+    published gauges) and the serving pool occupancy — each attached only
+    when something actually recorded it."""
+    ctx: dict = {}
+    if skew is not None:
+        ctx["skew"] = {
+            "step_seconds_max_over_min": skew["ratio"],
+            "slowest_rank": skew["slowest_rank"],
+        }
+    else:
+        ratio = gauge_value("skew.step_seconds_max_over_min")
+        if ratio is not None:
+            ctx["skew"] = {
+                "step_seconds_max_over_min": ratio,
+                "slowest_rank": gauge_value("skew.slowest_rank"),
+            }
+    active = gauge_value("serving.active_members")
+    if active is not None:
+        ctx["serving"] = {
+            "active_members": active,
+            "queue_depth": gauge_value("serving.queue_depth"),
+        }
+    return ctx
 
 
 def step_loop(

@@ -1,0 +1,716 @@
+"""Cross-rank observability plane: host spans, merged timelines, straggler
+detection and the crash flight recorder.
+
+The telemetry layer (`utils.telemetry`, PR 4) is strictly process-local —
+each rank keeps its own registry and event log, and nothing ever answers
+the questions a *cluster-level* claim (T_eff at scale, weak-scaling
+efficiency) actually raises: where did rank 3's step time go, which rank is
+the straggler, what was in flight when the run died.  This module is the
+cross-rank half (docs/observability.md):
+
+* **Spans** — `trace_span("igg.step", step=n)` is a nestable host-side
+  context manager recording ``(name, t0, dur, tags)`` into a bounded
+  per-process ring buffer (``IGG_TRACE_RING``, default `RING_DEFAULT`).
+  Span names reuse the compiled-HLO annotation names where one exists
+  (``igg_halo_exchange``, ``igg_slab_exchange_begin`` ... — see
+  `utils.compat.named_scope`), so a host span and the device ops it
+  dispatched correlate BY NAME across a merged trace and a profiler
+  capture.  With ``IGG_TELEMETRY=0`` (or ``IGG_TRACE_RING=0``) every call
+  returns one shared no-op singleton — no allocation, no clock reads.
+* **Merged timeline** — `dump_trace(dir)` writes this rank's spans plus its
+  clock-sync anchor as ``trace.p<rank>.json``; `merge_trace_files` joins
+  any set of per-rank files into ONE valid Chrome-trace/Perfetto JSON with
+  one track (pid) per rank on a shared clock.  Cross-rank alignment comes
+  from the barrier-timestamped sync `record_clock_sync` takes at
+  `init_global_grid`: every rank leaves the same barrier at (approximately)
+  the same true instant, so per-rank ``perf_counter`` readings taken right
+  at barrier exit anchor one common time zero.  The *honesty bound*: ranks
+  do not exit a barrier simultaneously — the alignment error is bounded by
+  each rank's measured barrier duration (microseconds on ICI, up to
+  milliseconds on slow fabrics), and the merged trace records the per-rank
+  offset AND that uncertainty in its metadata rather than pretending ns
+  precision.
+* **Straggler detection** — `skew_probe(step_seconds)` shares each rank's
+  last-window mean step wall time with every other rank through ONE tiny
+  replicated collective (the same scatter/psum shape as
+  `resilience.check_fields`' probe and the chunked gather's block fetch —
+  host-dispatched at heartbeat cadence, never inside the step program) and
+  publishes ``skew.step_seconds_max_over_min`` / ``skew.slowest_rank``
+  gauges plus a rank-tagged ``skew.straggler`` event when the ratio
+  exceeds ``IGG_SKEW_WARN``.  Single-process grids skip the probe
+  entirely.  The probe is a COLLECTIVE: every process must call it at the
+  same cadence (the step-count cadence guarantees that), and ranks must
+  agree on ``IGG_TELEMETRY`` / ``IGG_HEARTBEAT_EVERY`` or the others hang
+  waiting — same contract as every other collective in the package.
+* **Flight recorder** — `dump_flight_recorder(reason, ...)` bundles the
+  span ring, the current metrics snapshot and the active config into ONE
+  crash-safe ``flight_<rank>.json`` line (single ``O_APPEND`` ``os.write``,
+  the event-log discipline: complete lines or nothing, even through an
+  ``os._exit`` right after).  `utils.resilience` calls it on a guard trip,
+  a watchdog deadline and an injected worker crash.
+
+Layering: imports only `config` and `telemetry` at module scope; jax and
+the grid are reached lazily so the module stays importable in a broken
+accelerator env (the flight recorder is most valuable exactly then).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Sequence
+
+from . import config as _config
+from . import telemetry as _telemetry
+
+__all__ = [
+    "trace_span",
+    "span_records",
+    "span_summary",
+    "record_clock_sync",
+    "clock_sync",
+    "dump_trace",
+    "merge_trace_files",
+    "validate_chrome_trace",
+    "skew_probe",
+    "dump_flight_recorder",
+    "reset",
+]
+
+#: default span ring capacity (``IGG_TRACE_RING`` overrides; 0 disables).
+#: 4096 spans ≈ a few hundred KB — bounded however long the run.
+RING_DEFAULT = 4096
+
+#: per-rank trace file schema version (`dump_trace` / `merge_trace_files`)
+TRACE_SCHEMA = 1
+
+
+def _ring_capacity() -> int:
+    val = _config.trace_ring_env()
+    return RING_DEFAULT if val is None else val
+
+
+def enabled() -> bool:
+    """Span recording is on: telemetry master switch AND a nonzero ring."""
+    return _telemetry.enabled() and _ring_capacity() > 0
+
+
+# -- the span ring ------------------------------------------------------------
+
+_lock = threading.Lock()
+_ring: collections.deque | None = None
+_ring_cap = 0
+
+
+def _get_ring(cap: int) -> collections.deque:
+    """The process ring, re-bounded when ``IGG_TRACE_RING`` changed."""
+    global _ring, _ring_cap
+    with _lock:
+        if _ring is None or _ring_cap != cap:
+            _ring = collections.deque(_ring, maxlen=cap) if _ring else \
+                collections.deque(maxlen=cap)
+            _ring_cap = cap
+        return _ring
+
+
+class _Span:
+    """One live span.  Records itself into the ring on exit; re-entrant
+    use records one span per enter/exit pair."""
+
+    __slots__ = ("name", "tags", "t0")
+
+    def __init__(self, name: str, tags: dict | None):
+        self.name = name
+        self.tags = tags
+
+    def __enter__(self) -> "_Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        _get_ring(_ring_capacity()).append(
+            (self.name, self.t0, t1 - self.t0, self.tags)
+        )
+
+
+class _NoopSpan:
+    """Shared disabled-mode singleton (identity-stable, like
+    `telemetry.NOOP`): no clock reads, no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def trace_span(name: str, **tags: Any):
+    """A host-side span context manager recording into the process ring.
+
+    Nestable (Chrome-trace ``X`` events on one track render nesting from
+    containment); tags become the span's ``args`` in a merged trace.
+    Returns the shared `NOOP_SPAN` when tracing is disabled — the
+    zero-overhead contract of the rest of the registry.
+    """
+    if not enabled():
+        return NOOP_SPAN
+    return _Span(name, tags or None)
+
+
+def span_records() -> list[dict]:
+    """The current ring as plain dicts (oldest first; test/dump hook)."""
+    with _lock:
+        items = list(_ring) if _ring else []
+    return [
+        {"name": n, "t0": t0, "dur": dur, **({"args": tags} if tags else {})}
+        for n, t0, dur, tags in items
+    ]
+
+
+def span_summary() -> dict:
+    """``{span name: {count, total_s, mean_s, max_s}}`` over the ring —
+    the aggregate view `bench.py` ships in its artifact."""
+    agg: dict[str, list] = {}
+    with _lock:
+        items = list(_ring) if _ring else []
+    for name, _t0, dur, _tags in items:
+        rec = agg.setdefault(name, [0, 0.0, 0.0])
+        rec[0] += 1
+        rec[1] += dur
+        rec[2] = max(rec[2], dur)
+    return {
+        name: {
+            "count": c,
+            "total_s": total,
+            "mean_s": total / c,
+            "max_s": mx,
+        }
+        for name, (c, total, mx) in sorted(agg.items())
+    }
+
+
+# -- clock sync ---------------------------------------------------------------
+
+# The barrier-timestamped anchor (set once per grid epoch by
+# `record_clock_sync`): {"wall", "perf", "uncertainty_s", "epoch",
+# "barrier": bool}.  ``perf`` is this process's perf_counter at barrier
+# exit; all ranks' ``perf`` values name (approximately) the same true
+# instant, which is what merge alignment uses.
+_clock_sync: dict | None = None
+
+
+def record_clock_sync(barrier_fn=None, *, epoch: int | None = None) -> dict:
+    """Take the cross-rank clock-sync sample (called at `init_global_grid`).
+
+    ``barrier_fn`` (multi-process grids): a callable that returns only when
+    every process reached it — the ranks' clock samples taken right after
+    it anchor one shared instant.  The recorded ``uncertainty_s`` is the
+    measured barrier duration: a rank can exit at most one barrier-length
+    after the first exiter, so per-rank alignment error is bounded by it
+    (document-honest — no ns claims).  Without a barrier (single process)
+    the sample is exact by construction (uncertainty 0).
+    """
+    global _clock_sync
+    uncertainty = 0.0
+    if barrier_fn is not None:
+        tb = time.perf_counter()
+        barrier_fn()
+        uncertainty = time.perf_counter() - tb
+    perf = time.perf_counter()
+    wall = time.time()
+    _clock_sync = {
+        "wall": wall,
+        "perf": perf,
+        "uncertainty_s": uncertainty,
+        "epoch": epoch,
+        "barrier": barrier_fn is not None,
+    }
+    _telemetry.event(
+        "clock.sync",
+        wall=wall,
+        perf=perf,
+        uncertainty_s=uncertainty,
+        barrier=barrier_fn is not None,
+    )
+    return _clock_sync
+
+
+def clock_sync() -> dict:
+    """The active sync anchor; synthesized (``barrier: False``) when no
+    grid init ran — the merge then aligns by wall clocks only and says so."""
+    if _clock_sync is not None:
+        return _clock_sync
+    return {
+        "wall": time.time(),
+        "perf": time.perf_counter(),
+        "uncertainty_s": None,
+        "epoch": None,
+        "barrier": False,
+    }
+
+
+# -- per-rank dump + merge ----------------------------------------------------
+
+
+def trace_filename(rank: int) -> str:
+    return f"trace.p{rank}.json"
+
+
+def dump_trace(directory: str | os.PathLike | None = None) -> str | None:
+    """Write this rank's span file (``trace.p<rank>.json``) into
+    ``directory`` (default ``IGG_TELEMETRY_DIR``).  Returns the path, or
+    None when telemetry is disabled / no directory resolves.  Exported as
+    ``igg.dump_trace``; merge any set of ranks' files with
+    ``scripts/igg_trace.py merge`` (or `merge_trace_files`)."""
+    if not _telemetry.enabled():
+        return None
+    directory = os.fspath(directory) if directory else _config.telemetry_dir_env()
+    if not directory:
+        return None
+    rank = _telemetry._proc_index()
+    doc = {
+        "schema": TRACE_SCHEMA,
+        "rank": rank,
+        "pid": os.getpid(),
+        "coords": _telemetry._grid_coords(),
+        "clock_sync": clock_sync(),
+        "spans": span_records(),
+    }
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, trace_filename(rank))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=str)
+    return path
+
+
+def _load_rank_trace(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != TRACE_SCHEMA:
+        raise ValueError(
+            f"{path}: unsupported trace schema {doc.get('schema')!r} "
+            f"(expected {TRACE_SCHEMA})."
+        )
+    if "rank" not in doc or "spans" not in doc or "clock_sync" not in doc:
+        raise ValueError(f"{path}: not a per-rank trace file (missing keys).")
+    return doc
+
+
+#: max wall-clock disagreement (s) between two ranks' barrier-exit samples
+#: before the merge refuses to treat them as the SAME barrier.  Same-run
+#: samples differ by barrier-exit skew + NTP skew (well under a second);
+#: anything bigger means the files come from different runs — the classic
+#: stale-dump-in-a-reused-IGG_TELEMETRY_DIR hazard.
+BARRIER_WALL_TOL_S = 2.0
+
+
+def merge_trace_files(paths: Sequence[str | os.PathLike]) -> dict:
+    """Join per-rank span files into one Chrome-trace/Perfetto JSON object.
+
+    One track (pid) per rank; ``X`` (complete) events carry the span tags
+    as ``args``.  Alignment: the lowest rank is the anchor — its
+    barrier-exit wall time defines the absolute axis, and every rank's
+    spans shift by ``(own perf at barrier exit)`` so all tracks share the
+    barrier instant as time zero.  The per-rank offset and its uncertainty
+    (the measured barrier duration — the honesty bound on cross-rank
+    ordering) land in ``otherData.clock_alignment``; a rank whose sync was
+    not barrier-anchored (``barrier: false``) is aligned by wall clock
+    instead and flagged, since nothing ties its perf counter to the
+    others'.  Events are sorted by (pid, ts), so each track's timestamps
+    are monotonic — the tier-1 validity pin.
+
+    Barrier-anchored inputs must describe the SAME barrier, or the merged
+    "aligned" clock is a lie: the merge refuses files whose grid epochs
+    differ or whose barrier-exit wall samples disagree by more than
+    `BARRIER_WALL_TOL_S` (a stale ``trace.p*.json`` from a previous run
+    left in a reused telemetry dir is exactly this shape — delete it, or
+    pass the current run's files explicitly).
+    """
+    docs = sorted(
+        (_load_rank_trace(os.fspath(p)) for p in paths),
+        key=lambda d: d["rank"],
+    )
+    if not docs:
+        raise ValueError("merge_trace_files: no per-rank trace files given.")
+    ranks = [d["rank"] for d in docs]
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(
+            f"merge_trace_files: duplicate rank(s) in inputs ({ranks}) — "
+            f"each rank contributes exactly one file."
+        )
+    anchor = docs[0]["clock_sync"]
+    for doc in docs[1:]:
+        sync = doc["clock_sync"]
+        if not (sync.get("barrier") and anchor.get("barrier")):
+            continue  # wall-aligned below, flagged — no same-barrier claim
+        wall_delta = abs(sync["wall"] - anchor["wall"])
+        if (
+            sync.get("epoch") != anchor.get("epoch")
+            or wall_delta > BARRIER_WALL_TOL_S
+        ):
+            raise ValueError(
+                f"merge_trace_files: rank {doc['rank']}'s barrier anchor "
+                f"does not match rank {docs[0]['rank']}'s (epoch "
+                f"{sync.get('epoch')} vs {anchor.get('epoch')}, barrier "
+                f"wall samples {wall_delta:.1f}s apart > "
+                f"{BARRIER_WALL_TOL_S}s) — the files describe different "
+                f"runs/barriers and cannot share an aligned clock.  A "
+                f"stale trace.p*.json from a previous run in a reused "
+                f"telemetry dir looks exactly like this: delete it, or "
+                f"merge the current run's files explicitly."
+            )
+    events: list[dict] = []
+    alignment: dict[str, Any] = {
+        "anchor_rank": docs[0]["rank"],
+        "anchor_wall_unix_s": anchor["wall"],
+        "note": (
+            "per-rank perf_counter timelines are aligned on the barrier "
+            "instant recorded at init_global_grid; cross-rank ordering is "
+            "trustworthy only beyond each rank's uncertainty_s (the "
+            "measured barrier duration) — wall-clock-aligned ranks "
+            "(barrier_aligned=false) carry whatever NTP skew the hosts "
+            "have."
+        ),
+        "per_rank": {},
+    }
+    for doc in docs:
+        sync = doc["clock_sync"]
+        barrier_aligned = bool(sync.get("barrier")) and bool(
+            anchor.get("barrier")
+        )
+        if barrier_aligned:
+            # span perf t -> seconds since the shared barrier instant.
+            offset = -sync["perf"]
+        else:
+            # No shared barrier: fall back to wall-clock alignment, re-based
+            # so the anchor rank's barrier (or sample) instant is still zero.
+            offset = (sync["wall"] - anchor["wall"]) - sync["perf"]
+        alignment["per_rank"][str(doc["rank"])] = {
+            "barrier_aligned": barrier_aligned,
+            "offset_s": offset,
+            "uncertainty_s": sync.get("uncertainty_s"),
+            "wall_at_sync_unix_s": sync.get("wall"),
+        }
+        pid = doc["rank"]
+        coords = doc.get("coords")
+        name = f"rank {pid}" + (f" coords {tuple(coords)}" if coords else "")
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        )
+        for s in doc["spans"]:
+            ev = {
+                "ph": "X",
+                "name": s["name"],
+                "pid": pid,
+                "tid": 0,
+                "ts": (s["t0"] + offset) * 1e6,
+                "dur": s["dur"] * 1e6,
+            }
+            if s.get("args"):
+                ev["args"] = s["args"]
+            events.append(ev)
+    # Re-base so the earliest event sits at ts=0 (viewers dislike huge or
+    # negative timestamps); the absolute anchor lives in the metadata.
+    xs = [e["ts"] for e in events if e["ph"] == "X"]
+    base = min(xs) if xs else 0.0
+    for e in events:
+        if e["ph"] == "X":
+            e["ts"] -= base
+    alignment["ts_zero_offset_s"] = base / 1e6
+    events.sort(key=lambda e: (e["pid"], e.get("ts", -1.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"clock_alignment": alignment},
+    }
+
+
+def validate_chrome_trace(doc: dict) -> list[str]:
+    """Problems with a merged trace (empty list = valid): the tier-1 /
+    soak check that the artifact really is loadable Chrome-trace JSON with
+    per-track monotonic timestamps and alignment metadata.  NaN/inf
+    timestamps are rejected explicitly — Python's json writes them but
+    strict parsers (and the trace viewers) refuse the artifact, and a NaN
+    would additionally sail through the monotonicity comparison."""
+    import math
+
+    problems: list[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is missing or not a list"]
+    last_ts: dict[Any, float] = {}
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or "ph" not in e or "pid" not in e:
+            problems.append(f"event {i} malformed: {e!r}")
+            continue
+        if e["ph"] != "X":
+            continue
+        for key in ("name", "ts", "dur"):
+            if key not in e:
+                problems.append(f"event {i} missing {key!r}")
+        ts = e.get("ts")
+        if (
+            not isinstance(ts, (int, float))
+            or not math.isfinite(ts)
+            or ts < 0
+        ):
+            problems.append(f"event {i} has non-finite/negative ts {ts!r}")
+            continue
+        dur = e.get("dur")
+        if isinstance(dur, (int, float)) and (
+            not math.isfinite(dur) or dur < 0
+        ):
+            problems.append(f"event {i} has non-finite/negative dur {dur!r}")
+        if ts < last_ts.get(e["pid"], float("-inf")):
+            problems.append(
+                f"event {i} breaks track pid={e['pid']} monotonicity "
+                f"({ts} after {last_ts[e['pid']]})"
+            )
+        last_ts[e["pid"]] = ts
+    if "clock_alignment" not in doc.get("otherData", {}):
+        problems.append("otherData.clock_alignment metadata missing")
+    return problems
+
+
+# -- straggler detection ------------------------------------------------------
+
+#: default ``IGG_SKEW_WARN`` threshold on max/min per-rank step seconds
+SKEW_WARN_DEFAULT = 2.0
+
+_skew_cache: dict = {}
+
+
+def _clear_caches() -> None:
+    _skew_cache.clear()
+
+
+def _skew_fn(gg):
+    """The jitted all-ranks share of one host scalar per block: the same
+    scatter-into-one-hot + all-axes psum shape as `resilience.check_fields`
+    and the chunked gather's block fetch (`ops.gather._block_fetch_fn`) —
+    the one collective pattern proven on every supported transport.  The
+    result is a tiny replicated ``dims``-shaped array every process reads
+    host-side."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.topology import AXIS_NAMES, NDIMS
+    from .compat import shard_map
+
+    key = gg.epoch
+    fn = _skew_cache.get(key)
+    if fn is not None:
+        return fn
+
+    def per_block(x):
+        onehot = jnp.zeros(tuple(gg.dims), jnp.float32)
+        coords = tuple(
+            lax.axis_index(AXIS_NAMES[d]) if gg.dims[d] > 1 else jnp.int32(0)
+            for d in range(NDIMS)
+        )
+        onehot = lax.dynamic_update_slice(
+            onehot, x.astype(jnp.float32).reshape((1, 1, 1)), coords
+        )
+        return lax.psum(onehot, AXIS_NAMES)
+
+    mapped = shard_map(
+        per_block,
+        mesh=gg.mesh,
+        in_specs=P(*AXIS_NAMES),
+        out_specs=P(),
+        check_vma=False,
+    )
+    fn = jax.jit(mapped)
+    _skew_cache[key] = fn
+    return fn
+
+
+def all_ranks_value(value: float):
+    """Share one host scalar per process with every process.
+
+    Returns the replicated ``dims``-shaped numpy array (one entry per
+    block; every block a process owns carries that process's value), or
+    None on single-process grids — the probe is strictly a cross-process
+    diagnostic.  COLLECTIVE: every process must call it together.
+    """
+    import jax
+
+    from ..parallel import grid as _grid
+
+    if not _grid.grid_is_initialized() or jax.process_count() == 1:
+        return None
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.topology import AXIS_NAMES
+
+    gg = _grid.global_grid()
+    sharding = NamedSharding(gg.mesh, P(*AXIS_NAMES))
+    arr = jax.make_array_from_callback(
+        tuple(gg.dims),
+        sharding,
+        lambda idx: np.full((1, 1, 1), value, np.float32),
+    )
+    return np.asarray(_skew_fn(gg)(arr))
+
+
+def skew_probe(step_seconds: float, *, warn: float | None = None) -> dict | None:
+    """One all-ranks skew probe over the last window's step wall time.
+
+    Publishes the ``skew.step_seconds_max_over_min`` and
+    ``skew.slowest_rank`` gauges on every rank, fires a rank-tagged
+    ``skew.straggler`` event (plus the ``skew.straggler_total`` counter)
+    when the ratio exceeds ``warn`` (default ``IGG_SKEW_WARN``, built-in
+    `SKEW_WARN_DEFAULT`; 0 disables the event).  Returns the probe result
+    dict, or None on single-process grids (skipped entirely — no
+    collective, no gauges).  Collective; call at a deterministic cadence
+    on every process (the heartbeat cadence of the instrumented loops).
+    """
+    vals = all_ranks_value(float(step_seconds))
+    if vals is None:
+        return None
+    import numpy as np
+
+    from ..parallel import grid as _grid
+
+    gg = _grid.global_grid()
+    vmax = float(np.max(vals))
+    vmin = float(np.min(vals))
+    ratio = vmax / vmin if vmin > 0 else float("inf") if vmax > 0 else 1.0
+    slow_coords = tuple(
+        int(c) for c in np.unravel_index(int(np.argmax(vals)), vals.shape)
+    )
+    slowest_rank = int(gg.mesh.devices[slow_coords].process_index)
+    _telemetry.gauge("skew.step_seconds_max_over_min").set(ratio)
+    _telemetry.gauge("skew.slowest_rank").set(slowest_rank)
+    if warn is None:
+        env = _config.skew_warn_env()
+        warn = SKEW_WARN_DEFAULT if env is None else env
+    result = {
+        "ratio": ratio,
+        "slowest_rank": slowest_rank,
+        "slowest_coords": list(slow_coords),
+        "max_s": vmax,
+        "min_s": vmin,
+        "mine_s": float(step_seconds),
+    }
+    if warn and ratio > warn:
+        _telemetry.counter("skew.straggler_total").inc()
+        _telemetry.event("skew.straggler", warn=warn, **result)
+    return result
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def flight_filename(rank: int) -> str:
+    return f"flight_{rank}.json"
+
+
+def _active_config() -> dict:
+    """The run's active configuration for a flight bundle: every ``IGG_*``
+    env var plus the live grid's identity (when one is up)."""
+    cfg: dict[str, Any] = {
+        "env": {k: v for k, v in os.environ.items() if k.startswith("IGG_")},
+    }
+    try:
+        from ..parallel import grid as _grid
+
+        if _grid.grid_is_initialized():
+            gg = _grid.global_grid()
+            cfg["grid"] = {
+                "nxyz_g": list(gg.nxyz_g),
+                "nxyz": list(gg.nxyz),
+                "dims": list(gg.dims),
+                "coords": list(gg.coords),
+                "periods": list(gg.periods),
+                "overlaps": list(gg.overlaps),
+                "nprocs": gg.nprocs,
+                "me": gg.me,
+                "epoch": gg.epoch,
+            }
+    except Exception:  # the recorder must never raise out of a crash path
+        pass
+    return cfg
+
+
+def dump_flight_recorder(reason: str, **info: Any) -> str | None:
+    """Dump the crash flight-recorder bundle for this rank.
+
+    One JSON object — ``{ts, reason, rank, pid, coords, info, config,
+    metrics, spans}`` — appended as a single ``O_APPEND`` line to
+    ``flight_<rank>.json`` under ``IGG_TELEMETRY_DIR`` (several trips
+    append several lines; the last line is the newest bundle).  Crash-safe
+    by the event-log discipline: the write is one ``os.write`` of a
+    complete line, so a hard ``os._exit`` immediately after loses nothing.
+    Returns the path, or None when telemetry is off / no directory is set.
+    Never raises: a failing recorder must not mask the fault it records.
+    """
+    try:
+        if not _telemetry.enabled():
+            return None
+        directory = _config.telemetry_dir_env()
+        if not directory:
+            return None
+        rank = _telemetry._proc_index()
+        bundle = {
+            "ts": time.time(),
+            "reason": reason,
+            "rank": rank,
+            "pid": os.getpid(),
+            "coords": _telemetry._grid_coords(),
+            "info": info,
+            "config": _active_config(),
+            "metrics": _telemetry.snapshot(),
+            "spans": span_records(),
+        }
+        try:
+            line = json.dumps(bundle, default=str) + "\n"
+        except (TypeError, ValueError):
+            line = json.dumps(
+                {k: str(v) for k, v in bundle.items()}
+            ) + "\n"
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, flight_filename(rank))
+        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
+        _telemetry.counter("resilience.flight_dumps").inc()
+        return path
+    except Exception:
+        return None
+
+
+def read_flight_bundles(path: str | os.PathLike) -> list[dict]:
+    """Parse one ``flight_<rank>.json`` (one bundle per line, torn trailing
+    line skipped — the `telemetry.read_events` contract)."""
+    return _telemetry.read_events(path)
+
+
+def reset() -> None:
+    """Drop the span ring, clock sync and probe caches (test hook)."""
+    global _ring, _ring_cap, _clock_sync
+    with _lock:
+        _ring = None
+        _ring_cap = 0
+    _clock_sync = None
+    _skew_cache.clear()

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Merge / validate per-rank igg trace files (docs/observability.md).
+
+``igg.dump_trace(dir)`` leaves one ``trace.p<rank>.json`` per process;
+this tool joins any set of them into ONE Chrome-trace/Perfetto JSON on the
+shared barrier-aligned clock (one track per rank, alignment offsets and
+their honesty bound in ``otherData.clock_alignment``)::
+
+    python scripts/igg_trace.py merge RUN_DIR -o merged.json
+    python scripts/igg_trace.py merge trace.p0.json trace.p1.json -o m.json
+    python scripts/igg_trace.py validate merged.json
+
+Load ``merged.json`` at https://ui.perfetto.dev (or chrome://tracing).
+Exit codes: 0 ok, 1 invalid trace, 2 bad input/usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _expand(inputs: list[str]) -> list[str]:
+    """Trace files from a mix of files and directories (a directory means
+    every ``trace.p*.json`` in it)."""
+    paths: list[str] = []
+    for item in inputs:
+        if os.path.isdir(item):
+            found = sorted(glob.glob(os.path.join(item, "trace.p*.json")))
+            if not found:
+                raise FileNotFoundError(
+                    f"{item}: no trace.p*.json files (run with "
+                    f"IGG_TELEMETRY_DIR set and call igg.dump_trace)."
+                )
+            paths.extend(found)
+        else:
+            paths.append(item)
+    return paths
+
+
+def cmd_merge(args) -> int:
+    from implicitglobalgrid_tpu.utils import tracing
+
+    try:
+        paths = _expand(args.inputs)
+        doc = tracing.merge_trace_files(paths)
+    except (OSError, ValueError) as e:
+        print(f"igg_trace: {e}", file=sys.stderr)
+        return 2
+    problems = tracing.validate_chrome_trace(doc)
+    if problems:
+        for p in problems:
+            print(f"igg_trace: INVALID merged trace: {p}", file=sys.stderr)
+        return 1
+    out = json.dumps(doc)
+    if args.output == "-":
+        print(out)
+    else:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(out)
+        nspans = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+        ranks = sorted({e["pid"] for e in doc["traceEvents"]})
+        print(
+            f"igg_trace: wrote {args.output}: {nspans} span(s) across "
+            f"rank(s) {ranks} — load it at https://ui.perfetto.dev",
+            file=sys.stderr,
+        )
+    return 0
+
+
+def cmd_validate(args) -> int:
+    from implicitglobalgrid_tpu.utils import tracing
+
+    try:
+        with open(args.trace, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"igg_trace: {args.trace}: {e}", file=sys.stderr)
+        return 2
+    problems = tracing.validate_chrome_trace(doc)
+    for p in problems:
+        print(f"igg_trace: {args.trace}: {p}", file=sys.stderr)
+    if not problems:
+        print(f"igg_trace: {args.trace}: valid", file=sys.stderr)
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="igg_trace.py",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="join per-rank trace files")
+    mp.add_argument("inputs", nargs="+",
+                    help="trace.pN.json files and/or directories")
+    mp.add_argument("-o", "--output", default="-",
+                    help="merged trace path ('-' = stdout)")
+    vp = sub.add_parser("validate", help="check a merged Chrome trace")
+    vp.add_argument("trace")
+    args = ap.parse_args(argv)
+    return cmd_merge(args) if args.cmd == "merge" else cmd_validate(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -203,6 +203,9 @@ def child_elastic_main(args) -> int:
         # The machine-readable run record (docs/observability.md): registry
         # snapshot as JSON + Prometheus text next to the field.
         igg.dump_metrics(args.out + ".metrics")
+    # Per-rank span file into IGG_TELEMETRY_DIR (no-op when unarmed): the
+    # orchestrator merges and validates the Chrome trace (--quick gate).
+    igg.dump_trace()
     igg.finalize_global_grid()
     print("SOAK CHILD OK", flush=True)
     return 0
@@ -477,10 +480,57 @@ def _verify_elastic_telemetry(tele_dir: str, got_out: str) -> tuple[bool, str]:
             return False, f"non-numeric Prometheus sample {line!r}"
     if "igg_diffusion3d_t_eff_gbs" not in prom:
         return False, "T_eff summary missing from the Prometheus exposition"
+
+    # Flight recorder (docs/observability.md): the injected crash on proc 1
+    # must have left a bundle with the span ring, metrics snapshot and
+    # active config BEFORE its hard exit.
+    from implicitglobalgrid_tpu.utils import tracing
+
+    flight = os.path.join(tele_dir, tracing.flight_filename(1))
+    if not os.path.isfile(flight):
+        return False, f"no flight-recorder bundle {flight} from the crash"
+    bundles = tracing.read_flight_bundles(flight)
+    crash_bundles = [
+        b for b in bundles if b.get("reason") == "fault.worker_crash"
+    ]
+    if not crash_bundles:
+        return False, (
+            f"{flight}: no fault.worker_crash bundle "
+            f"(reasons: {[b.get('reason') for b in bundles]})"
+        )
+    bundle = crash_bundles[-1]
+    missing = [k for k in ("metrics", "config", "spans") if k not in bundle]
+    if missing:
+        return False, f"flight bundle missing section(s) {missing}"
+    if bundle.get("rank") != 1:
+        return False, f"flight bundle rank {bundle.get('rank')} != 1"
+
+    # Merged-trace validation: the restart's span dump must merge into a
+    # valid Chrome trace carrying the instrumented spans.
+    tfiles = sorted(glob.glob(os.path.join(tele_dir, "trace.p*.json")))
+    if not tfiles:
+        return False, f"no trace.p*.json span dumps under {tele_dir}"
+    try:
+        doc = tracing.merge_trace_files(tfiles)
+    except (OSError, ValueError) as e:
+        return False, f"trace merge failed ({e!r})"
+    problems = tracing.validate_chrome_trace(doc)
+    if problems:
+        return False, f"merged trace invalid: {problems[:3]}"
+    span_names = {
+        e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+    }
+    for need_span in ("igg.step", "igg.checkpoint.restore"):
+        if need_span not in span_names:
+            return False, (
+                f"merged trace lacks '{need_span}' spans "
+                f"(saw {sorted(span_names)})"
+            )
     return True, (
         f"{len(events)} events across {len(files)} rank file(s): "
         f"crash -> fallback -> elastic reshard -> recovery in order; "
-        f"T_eff over {teff['count']} step(s)"
+        f"T_eff over {teff['count']} step(s); crash flight bundle ok; "
+        f"merged trace valid ({len(span_names)} span name(s))"
     )
 
 

@@ -398,6 +398,39 @@ _mine = [e for e in tele.read_events(_ev_file) if e["type"] == "worker.check"]
 assert len(_mine) == 1 and _mine[0]["rank"] == pid, _mine
 assert _mine[0]["coords"] == list(igg.get_global_grid().coords), _mine
 
+# --- Cross-rank observability plane (ISSUE 10): run a short instrumented
+# loop at heartbeat cadence so the all-ranks SKEW PROBE rides the real gloo
+# transport (both ranks enter the replicated share at steps 2 and 4 — a
+# cadence mismatch would deadlock right here, which is the point), then
+# dump this rank's span file for the parent's merged-Chrome-trace check.
+from implicitglobalgrid_tpu.utils import tracing as _tracing
+from implicitglobalgrid_tpu.utils.resilience import RunGuard, guarded_time_loop
+from implicitglobalgrid_tpu.utils.telemetry import teff_bytes
+
+assert _tracing.clock_sync()["barrier"], (
+    "multi-process init_global_grid must record a barrier-anchored "
+    "clock sync"
+)
+os.environ["IGG_HEARTBEAT_EVERY"] = "2"
+try:
+    state5, params5 = diffusion3d.setup(NX, NX, NX, init_grid=False)
+    state5 = guarded_time_loop(
+        diffusion3d.make_step(params5), state5, 4, guard=RunGuard(),
+        sync_every_step=True, model="diffusion3d",
+        bytes_per_step=teff_bytes(state5[:1]),
+    )
+finally:
+    del os.environ["IGG_HEARTBEAT_EVERY"]
+_snap = tele.snapshot()
+assert _snap["gauges"].get("skew.step_seconds_max_over_min", 0.0) >= 1.0, (
+    "skew probe did not publish its gauges over the gloo transport",
+    _snap["gauges"],
+)
+assert _snap["gauges"].get("skew.slowest_rank") in (0.0, 1.0), _snap["gauges"]
+_trace_path = igg.dump_trace(os.environ["IGG_TELEMETRY_DIR"])
+assert _trace_path is not None and os.path.isfile(_trace_path), _trace_path
+assert _trace_path.endswith(f"trace.p{pid}.json"), _trace_path
+
 igg.finalize_global_grid()
 assert not igg.grid_is_initialized()
 assert not dist.is_distributed_initialized()  # finalize tore the runtime down

@@ -58,13 +58,14 @@ def test_every_suppression_fired_with_a_justification(full_report):
     for finding, justification in full_report.suppressed:
         assert finding.analyzer in ("knob-binding", "bench-regression")
         assert len(justification) > 40
-    # the triaged set is exactly: 3 documented knob-binding contracts +
+    # the triaged set is exactly: 4 documented knob-binding contracts
+    # (IGG_COALESCE / IGG_TELEMETRY / IGG_VMEM_MB / IGG_TRACE_RING) +
     # the 2 historical truncated BENCH rounds (r01/r05) + the r04 porous
     # config retirement (npt10_w2 -> npt10_w6_ragged)
     by_analyzer = {}
     for finding, _ in full_report.suppressed:
         by_analyzer.setdefault(finding.analyzer, []).append(finding)
-    assert len(by_analyzer["knob-binding"]) == 3
+    assert len(by_analyzer["knob-binding"]) == 4
     assert sorted((f.code, f.symbol)
                   for f in by_analyzer["bench-regression"]) == [
         ("metric-vanished", "r04"),

@@ -496,7 +496,7 @@ def test_knob_binding_follows_calls_and_accessor_args(tmp_path):
 
 def test_real_package_knob_binding_matches_the_baseline():
     """Triage pin: every knob-binding finding on the REAL package is one of
-    the three baselined per-trace contracts — a new traced env read must
+    the four baselined per-trace contracts — a new traced env read must
     show up here (and fail tier-1 via test_lint_suite) until triaged."""
     from implicitglobalgrid_tpu.analysis.knobs import run_knob_binding
 
@@ -506,6 +506,9 @@ def test_real_package_knob_binding_matches_the_baseline():
     assert unbaselined == [], [f.message for f in unbaselined]
     assert {f.anchor for f in found} == {
         "IGG_COALESCE", "IGG_TELEMETRY", "IGG_VMEM_MB",
+        # ISSUE 10: begin/finish_slab_exchange's trace-time spans read the
+        # ring capacity — same documented contract as IGG_TELEMETRY
+        "IGG_TRACE_RING",
     }
 
 

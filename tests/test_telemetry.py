@@ -215,9 +215,14 @@ def test_heartbeat_line_and_event(monkeypatch, tmp_path, capfd):
     events = tele.read_events(tmp_path / "events.jsonl")
     types = [e["type"] for e in events]
     assert types.count("heartbeat") == 2
-    assert types[0] == "run.start" and types[-1] == "run.complete"
+    # init emits the clock-sync anchor first (utils.tracing), then the run
+    assert types[0] == "clock.sync"
+    assert types[1] == "run.start" and types[-1] == "run.complete"
     hb = next(e for e in events if e["type"] == "heartbeat")
     assert hb["model"] == "diffusion3d" and hb["t_eff_gbs"] > 0
+    # single-process run: no skew probe ran and no serving pool exists, so
+    # the extended context attaches neither section (absence is explicit)
+    assert "skew" not in hb and "serving" not in hb
 
 
 def test_teff_bytes_model():
@@ -281,13 +286,15 @@ def test_checkpoint_events_and_counters(monkeypatch, tmp_path):
     assert snap["counters"]["checkpoint.prunes"] == 1
     events = tele.read_events(tmp_path / "tele" / "events.jsonl")
     types = [e["type"] for e in events]
+    # the init-time clock-sync anchor leads, then the checkpoint sequence
     assert types == [
+        "clock.sync",
         "checkpoint.saved",
         "checkpoint.restore",
         "checkpoint.saved",
         "checkpoint.prune",
     ]
-    restore = events[1]
+    restore = next(e for e in events if e["type"] == "checkpoint.restore")
     assert restore["mode"] == "same_topology" and restore["step"] == 2
 
 
@@ -501,3 +508,176 @@ def test_gather_member_counter_folds_into_gather_family(monkeypatch):
     snap = tele.snapshot()
     assert snap["counters"]["gather.member_calls"] == 1
     assert snap["counters"]["gather.calls"] == 1  # the slice gather itself
+
+
+# -- Tenant-series cardinality cap (ISSUE 10 satellite) -----------------------
+
+
+def test_tenant_counter_caps_distinct_series(monkeypatch):
+    """Tenant strings arrive from requests: the per-tenant counter family
+    must stay bounded.  Past ``IGG_TELEMETRY_MAX_TENANTS`` distinct
+    tenants, new ones fold into ``serving.tenant.__other__.steps`` while
+    existing tenants keep their own series — and the family's TOTAL stays
+    exact."""
+    monkeypatch.setenv("IGG_TELEMETRY_MAX_TENANTS", "2")
+    tele.tenant_counter("alice").inc(3)
+    tele.tenant_counter("bob").inc(2)
+    # cap reached: carol and dave fold into the overflow series
+    tele.tenant_counter("carol").inc(5)
+    tele.tenant_counter("dave").inc(7)
+    # existing tenants keep attributing to their own series
+    tele.tenant_counter("alice").inc(1)
+    c = tele.snapshot()["counters"]
+    tenant_keys = {k for k in c if k.startswith("serving.tenant.")}
+    assert tenant_keys == {
+        "serving.tenant.alice.steps",
+        "serving.tenant.bob.steps",
+        tele.TENANT_OVERFLOW,
+    }
+    assert c["serving.tenant.alice.steps"] == 4
+    assert c["serving.tenant.bob.steps"] == 2
+    assert c[tele.TENANT_OVERFLOW] == 12
+    assert sum(c[k] for k in tenant_keys) == 18  # nothing lost to the cap
+
+
+def test_tenant_counter_default_cap_and_disabled(monkeypatch):
+    monkeypatch.delenv("IGG_TELEMETRY_MAX_TENANTS", raising=False)
+    for i in range(tele.MAX_TENANTS_DEFAULT + 5):
+        tele.tenant_counter(f"t{i}").inc()
+    c = tele.snapshot()["counters"]
+    distinct = [
+        k for k in c
+        if k.startswith("serving.tenant.") and k != tele.TENANT_OVERFLOW
+    ]
+    assert len(distinct) == tele.MAX_TENANTS_DEFAULT
+    assert c[tele.TENANT_OVERFLOW] == 5
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    assert tele.tenant_counter("x") is tele.NOOP
+
+
+def test_serving_loop_tenant_flood_stays_bounded(monkeypatch):
+    """Regression: the serving loop's per-tenant counters ride
+    `tenant_counter`, so a flood of one-request tenants cannot grow the
+    registry unboundedly."""
+    monkeypatch.setenv("IGG_TELEMETRY_MAX_TENANTS", "3")
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+
+    igg.init_global_grid(8, 8, 8, dimx=2, dimy=2, dimz=2, quiet=True)
+    s, params = diffusion3d.setup(8, 8, 8, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=2, steps_per_round=1)
+    for i in range(6):
+        si, _ = diffusion3d.setup(8, 8, 8, init_grid=False,
+                                  ic_scale=1.0 + 0.01 * i)
+        loop.submit(Request(state=si, max_steps=1, tenant=f"tenant{i}"))
+    res = loop.run(max_rounds=20)
+    assert len(res) == 6
+    c = tele.snapshot()["counters"]
+    tenant_keys = [k for k in c if k.startswith("serving.tenant.")]
+    assert len(tenant_keys) <= 4  # 3 distinct + __other__
+    assert sum(c[k] for k in tenant_keys) == 6  # every step attributed
+
+
+# -- Prometheus exposition edge cases (ISSUE 10 satellite) --------------------
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Minimal text-format (0.0.4) parser for the round-trip check:
+    ``{metric name: {"type": ..., "samples": {sample name+labels: value}}}``.
+    Samples attach to the preceding ``# TYPE`` block and must belong to it
+    (name prefix match) — raises on anything a standard scraper would
+    reject (sample before its header, duplicate headers, non-numeric
+    value, malformed line)."""
+    out: dict = {}
+    current = None
+    for line in text.splitlines():
+        if not line.strip():
+            raise ValueError("blank line in exposition")
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split()
+            if name in out:
+                raise ValueError(f"duplicate TYPE for {name}")
+            out[name] = {"type": mtype, "samples": {}}
+            current = name
+            continue
+        if line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            raise ValueError(f"malformed sample line {line!r}")
+        name_labels, value = parts
+        if current is None or not name_labels.startswith(current):
+            raise ValueError(f"sample outside its TYPE block: {line!r}")
+        out[current]["samples"][name_labels] = float(value)
+    return out
+
+
+def test_prometheus_name_sanitization_edge_cases():
+    # dots, hyphens and a LEADING DIGIT: all must sanitize to a valid
+    # Prometheus name (the igg_ prefix also rescues the leading digit).
+    tele.counter("9starts.with-digit").inc(2)
+    tele.gauge("weird-gauge.name-x").set(1.0)
+    text = tele.prometheus_text()
+    parsed = _parse_prometheus(text)
+    assert "igg_9starts_with_digit_total" in parsed
+    assert parsed["igg_9starts_with_digit_total"]["type"] == "counter"
+    assert "igg_weird_gauge_name_x" in parsed
+    import re
+
+    for name in parsed:
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), name
+
+
+def test_prometheus_empty_histogram_exposition():
+    tele.histogram("h.empty")  # created, never recorded
+    text = tele.prometheus_text()
+    parsed = _parse_prometheus(text)
+    h = parsed["igg_h_empty"]
+    assert h["type"] == "summary"
+    # no quantile lines (the reservoir is empty), but sum/count present
+    assert h["samples"] == {"igg_h_empty_sum": 0.0, "igg_h_empty_count": 0.0}
+    assert "None" not in text
+
+
+def test_prometheus_roundtrip_against_snapshot():
+    tele.counter("rt.count").inc(7)
+    tele.gauge("rt.gauge").set(-2.5)
+    h = tele.histogram("rt.hist")
+    for v in (1.0, 2.0, 4.0):
+        h.record(v)
+    snap = tele.snapshot()
+    parsed = _parse_prometheus(tele.prometheus_text(snap))
+    assert parsed["igg_rt_count_total"]["samples"]["igg_rt_count_total"] == 7.0
+    assert parsed["igg_rt_gauge"]["samples"]["igg_rt_gauge"] == -2.5
+    hs = parsed["igg_rt_hist"]["samples"]
+    assert hs["igg_rt_hist_sum"] == 7.0
+    assert hs["igg_rt_hist_count"] == 3.0
+    assert hs['igg_rt_hist{quantile="0.5"}'] == snap["histograms"]["rt.hist"]["p50"]
+    # every registry metric surfaced exactly once
+    assert len(parsed) == 3
+
+
+# -- Enriched heartbeat (ISSUE 10 satellite) ----------------------------------
+
+
+def test_heartbeat_attaches_skew_and_serving_context(monkeypatch, tmp_path):
+    """docs/observability.md heartbeat schema: when the skew gauges and
+    the serving occupancy gauges exist, the rank-0 heartbeat event carries
+    them; when they don't, the sections are absent (pinned by
+    test_heartbeat_line_and_event)."""
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("IGG_HEARTBEAT_EVERY", "1")
+    # a straggler probe and a serving pool published earlier this process
+    tele.gauge("skew.step_seconds_max_over_min").set(3.5)
+    tele.gauge("skew.slowest_rank").set(1)
+    tele.gauge("serving.active_members").set(2)
+    tele.gauge("serving.queue_depth").set(4)
+    loop = tele.step_loop("m", bytes_per_step=8, total_steps=1)
+    loop.on_step(1)
+    events = tele.read_events(tmp_path / "events.jsonl")
+    hb = next(e for e in events if e["type"] == "heartbeat")
+    assert hb["skew"] == {
+        "step_seconds_max_over_min": 3.5,
+        "slowest_rank": 1.0,
+    }
+    assert hb["serving"] == {"active_members": 2.0, "queue_depth": 4.0}
