@@ -474,6 +474,7 @@ def main():
     # alongside.
     try:
         import implicitglobalgrid_tpu as igg
+        from implicitglobalgrid_tpu.utils.liveplane import get_engine, slo_view
         from implicitglobalgrid_tpu.utils.tracing import span_summary
 
         snap = igg.telemetry_snapshot()
@@ -482,6 +483,11 @@ def main():
             "gauges": snap["gauges"],
             "histograms": snap["histograms"],
             "spans": span_summary(),
+            # ISSUE 11: the live-plane view of the same registry — the
+            # rolling-window quantiles (what /healthz would have served at
+            # the end of the round) and any anomaly alerts the run fired.
+            "slo_windows": slo_view(snap),
+            "alerts": get_engine().recent_alerts(),
         }
     except Exception as e:  # never let instrumentation sink the artifact
         extras["telemetry"] = {"error": f"{type(e).__name__}: {e}"}

@@ -41,8 +41,13 @@ per-rank trace dumps (`dump_trace`) mergeable into ONE barrier-aligned
 Chrome/Perfetto timeline (``scripts/igg_trace.py``), an all-ranks
 straggler probe at heartbeat cadence (``skew.*`` gauges), and a crash
 flight recorder (``flight_<rank>.json``) dumped on guard trips, watchdog
-deadlines and injected crashes.  ``IGG_TELEMETRY=0`` disables it all on a
-zero-allocation branch.
+deadlines and injected crashes.  The LIVE half (`utils.liveplane`,
+``IGG_METRICS_PORT``): per-rank HTTP scrape endpoints (``/metrics`` /
+``/healthz`` / ``/spans``), rolling SLO windows (``slo.*`` gauges over
+``IGG_SLO_WINDOW_S`` windows), an in-flight anomaly-rule engine firing
+structured ``alert.*`` events, and ``scripts/igg_top.py`` aggregating any
+set of rank endpoints into one cluster view.  ``IGG_TELEMETRY=0``
+disables it all on a zero-allocation branch (the server never starts).
 
 Static analysis (docs/static-analysis.md): ``igg.analysis`` — a pass
 registry running over the package AST, traced jaxprs of the public entry
@@ -95,6 +100,7 @@ from .utils.checkpoint import (
     save_checkpoint,
     verify_checkpoint,
 )
+from .utils import liveplane
 from .utils import telemetry
 from .utils import tracing
 from .utils.telemetry import dump_metrics, telemetry_snapshot
@@ -161,6 +167,7 @@ __all__ = [
     "tracing",
     "trace_span",
     "dump_trace",
+    "liveplane",
     # static-analysis subsystem (docs/static-analysis.md)
     "analysis",
     # batched multi-simulation serving (ISSUE 8; docs/api.md)

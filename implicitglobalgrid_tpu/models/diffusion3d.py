@@ -705,6 +705,12 @@ def run(
     try:
         from ..utils import tracing as _tracing
 
+        # Live plane up BEFORE the long bring-up/compile phase (no-op
+        # unless IGG_METRICS_PORT is set): an operator can scrape /healthz
+        # while the program is still building (docs/observability.md).
+        from ..utils import liveplane as _liveplane
+
+        _liveplane.ensure_server()
         # Setup span: grid bring-up + field allocation, distinct from the
         # per-step `igg.step` spans the loop records (docs/observability.md).
         with _tracing.trace_span("igg.run.setup", model="diffusion3d"):

@@ -896,8 +896,12 @@ def run(
 
     caller_owns_grid = grid_is_initialized()  # init_grid=False with a live grid
     try:
+        from ..utils import liveplane as _liveplane
         from ..utils import tracing as _tracing
 
+        # Live plane up BEFORE the long bring-up/compile phase (no-op
+        # unless IGG_METRICS_PORT is set; docs/observability.md).
+        _liveplane.ensure_server()
         with _tracing.trace_span("igg.run.setup", model="porous_convection3d"):
             state, params = setup(nx, ny, nz, **kw)
             step = make_step(params)

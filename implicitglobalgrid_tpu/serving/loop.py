@@ -45,6 +45,22 @@ slot, tenant and step count.  Each round runs inside an
 ``igg.serving.round`` host span (member/slot/tenant-tagged) and, at the
 ``IGG_HEARTBEAT_EVERY`` round cadence on multi-process grids, drives the
 all-ranks skew probe (`utils.tracing.skew_probe`).
+
+Live plane (ISSUE 11, `utils.liveplane`): construction brings the
+per-rank scrape server up when ``IGG_METRICS_PORT`` is set; every round
+records the ``serving.round_seconds`` histogram (whose rolling window
+becomes the ``slo.serving.round_seconds.*`` gauges — the SLO latency
+surface admission control will key on), each convergence sweep publishes
+the ``serving.pt_residual_min`` gauge (the convergence-stall rule's
+input), the heartbeat-cadence rounds run the anomaly-rule tick, and the
+loop polls the alert stream: a CRITICAL alert fires a
+``serving.alert_escalation`` event and — on single-process pools — an
+immediate out-of-cadence member-finite sweep through the existing evict
+machinery.  Multi-process pools stop at the event: slot mutations keyed
+on a rank-LOCAL alert would diverge the SPMD pool state across ranks
+(exactly the deadlock class ``igg.analysis``'s collective-consistency
+pass exists to catch), so cross-rank escalation stays an operator
+decision made on the `scripts/igg_top.py` cluster view.
 """
 
 from __future__ import annotations
@@ -57,8 +73,10 @@ import numpy as np
 
 from ..models import _batched
 from ..utils import config as _config
+from ..utils import liveplane as _liveplane
 from ..utils import telemetry as _telemetry
 from ..utils import tracing as _tracing
+from ..utils.telemetry import process_count as _process_count
 
 #: Per-model serving adapter: state field names and which fields the
 #: per-member T_eff bytes model counts (`telemetry.teff_bytes` convention),
@@ -208,6 +226,13 @@ class ServingLoop:
         self._state = None  # built lazily from the first admitted state
         self._blank = None  # zero member state for freed slots
         self._sig = None    # pool field signature: ((global shape, dtype), ...)
+        # Live plane (docs/observability.md): scrape endpoint up as soon as
+        # the pool exists (no-op unless IGG_METRICS_PORT is set), alert
+        # stream polled from this cursor each round.  The cursor starts at
+        # the engine's CURRENT seq: alerts fired before this pool existed
+        # belong to earlier runs and must not replay as escalations.
+        self._alert_seq, _ = _liveplane.alerts_since(float("inf"))
+        _liveplane.ensure_server()
 
     # -- pool state -----------------------------------------------------------
 
@@ -348,6 +373,13 @@ class ServingLoop:
         # retired member's fields into a future snapshot/result.
         self._state = _batched.set_member_state(self._state, self._blank, k)
         self.slots[k] = _Slot()
+        if self._residual_fn is not None and not any(
+            s.active and s.tol is not None for s in self.slots
+        ):
+            # The last tol-watched member just left: disarm the
+            # convergence-stall rule (its input gauge would otherwise
+            # freeze at the retiree's final residual).
+            _telemetry.gauge("serving.pt_residual_watched").set(0)
 
     # -- the round ------------------------------------------------------------
 
@@ -386,6 +418,10 @@ class ServingLoop:
 
                 jax.block_until_ready(self._state)
                 dt = time.perf_counter() - t0
+                # The serving-round latency surface: its rolling window is
+                # the slo.serving.round_seconds.* gauge family (the SLO the
+                # network-facing plane keys admission on — ROADMAP item 3).
+                _telemetry.histogram("serving.round_seconds").record(dt)
                 for k, slot in enumerate(self.slots):
                     if slot.active:
                         slot.steps += self.steps_per_round
@@ -417,13 +453,30 @@ class ServingLoop:
             self.rounds += 1
             _telemetry.counter("serving.rounds").inc()
             if _telemetry.enabled():
+                _telemetry.note_progress("serving.round", self.rounds)
                 hb = _config.heartbeat_every_env() or 0
                 # The gate must be rank-uniform (the probe is a collective):
                 # rounds and mask derive from the deterministic admit/retire
                 # sequence every rank drives identically — never from a
                 # locally measured time.
-                if hb and self.rounds % hb == 0 and mask.any():
-                    _tracing.skew_probe(dt / self.steps_per_round)
+                if hb and self.rounds % hb == 0:
+                    if mask.any():
+                        _tracing.skew_probe(dt / self.steps_per_round)
+                    # The live-plane tick is strictly LOCAL (slo gauges +
+                    # anomaly rules — no collectives), so it needs no
+                    # rank-uniformity gate.
+                    rss = _telemetry.proc_rss_bytes()
+                    if rss is not None:
+                        _telemetry.gauge("proc.rss_bytes").set(rss)
+                    _liveplane.heartbeat_tick(model="serving")
+                # Alert stream: a CRITICAL in-flight anomaly escalates into
+                # the guard/evict machinery instead of scrolling past.
+                self._alert_seq, fresh = _liveplane.alerts_since(
+                    self._alert_seq
+                )
+                for alert in fresh:
+                    if alert.get("severity") == "critical":
+                        self._escalate(alert)
             if (
                 self.checkpoint_every
                 and self.rounds % self.checkpoint_every == 0
@@ -465,12 +518,53 @@ class ServingLoop:
                     slot.snapshot = _batched.member_state(self._state, k)
                     slot.snapshot_steps = slot.steps
 
+    def _escalate(self, alert: dict) -> None:
+        """React to one CRITICAL live-plane alert (module docstring): event
+        always; on single-process pools additionally force an immediate
+        member-finite sweep through the evict machinery (rank-local alerts
+        must never mutate the SPMD pool state on multi-process grids)."""
+        _telemetry.counter("serving.alert_escalations").inc()
+        _telemetry.event(
+            "serving.alert_escalation",
+            rule=alert.get("rule"),
+            severity=alert.get("severity"),
+            evidence=alert.get("evidence"),
+        )
+        if self._state is None or _process_count() > 1:
+            return
+        mask = self._mask()
+        if not mask.any():
+            return
+        if self.guard_policy == "off":
+            # the per-round sweep is off: run one forced evict-mode sweep
+            bad = _batched.check_members_finite(self._state)
+            for k in np.flatnonzero(bad & mask):
+                self._retire(int(k), "evicted")
+        else:
+            self._guard(mask)
+
     def _convergence(self) -> None:
         if self._residual_fn is None:
             return
         if not any(s.active and s.tol is not None for s in self.slots):
+            # Nothing watched: zero the population gauge so the
+            # convergence-stall rule stands down instead of chewing on the
+            # last retired member's frozen residual forever.
+            _telemetry.gauge("serving.pt_residual_watched").set(0)
             return
         res = np.asarray(self._residual_fn(*self._state))
+        watched = [
+            float(res[k])
+            for k, slot in enumerate(self.slots)
+            if slot.active and slot.tol is not None
+        ]
+        if watched:
+            # The convergence-stall anomaly rule's input
+            # (utils.liveplane.ConvergenceStallRule): the best residual
+            # still being driven toward a tolerance this round, plus how
+            # many members it speaks for (0 disarms the rule).
+            _telemetry.gauge("serving.pt_residual_min").set(min(watched))
+            _telemetry.gauge("serving.pt_residual_watched").set(len(watched))
         for k, slot in enumerate(self.slots):
             if (
                 slot.active
@@ -488,6 +582,11 @@ class ServingLoop:
         ):
             self.run_round()
             n += 1
+        if _telemetry.enabled() and not (self.queue or self.active_members):
+            # A drained pool is not a stalled one: mark the progress record
+            # done so the live plane's step-stall rule goes quiet while the
+            # loop idles between request bursts.
+            _telemetry.note_progress("serving.round", self.rounds, done=True)
         return self.results
 
     # -- batched checkpointing ------------------------------------------------

@@ -61,6 +61,7 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_FAULT_INJECT``      fault-injection knob for the test/soak harness:
                           ``init_flake:N`` | ``halo_corrupt:stepN[:blockB]``
                           | ``worker_crash:stepN[:procP]``
+                          | ``stall:stepN[:procP]``
                           | ``ckpt_corrupt:stepN[:shardS]``
                           | ``ckpt_truncate:stepN[:shardS]``; several faults
                           compose comma-separated (docs/robustness.md)
@@ -101,6 +102,25 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_TELEMETRY_MAX_TENANTS``  cap on distinct ``serving.tenant.<t>.steps``
                           counter series (int >= 1, default 64); overflow
                           tenants fold into ``serving.tenant.__other__.steps``
+``IGG_METRICS_PORT``      live-plane scrape port (`utils.liveplane`): unset =
+                          no HTTP server (the default); ``0`` = bind an
+                          ephemeral port (published via the
+                          ``liveplane.port`` gauge, the rank-0 heartbeat
+                          event and a ``liveplane.p<rank>.json`` endpoint
+                          file under ``IGG_TELEMETRY_DIR``); N > 0 = bind
+                          exactly N.  Never consulted when ``IGG_TELEMETRY=0``
+                          (the server does not start)
+``IGG_METRICS_HOST``      bind address of the live-plane server (default
+                          ``127.0.0.1`` — loopback only; the endpoints are
+                          unauthenticated read-only snapshots, widen the
+                          bind deliberately)
+``IGG_SLO_WINDOW_S``      length in seconds of one rolling SLO sub-window of
+                          every `utils.telemetry.Histogram` (number > 0,
+                          default 30; `telemetry.SLO_WINDOW_S_DEFAULT`) —
+                          the ``window`` section of histogram summaries and
+                          the ``slo.*`` gauges aggregate the last
+                          `telemetry.SLO_WINDOWS` windows (read per window
+                          rollover, like the other telemetry knobs)
 ========================  ====================================================
 
 Explicit kwargs always win over env values; env values win over built-in
@@ -357,3 +377,25 @@ def telemetry_max_tenants_env() -> int | None:
     """``IGG_TELEMETRY_MAX_TENANTS``: cap on distinct per-tenant counter
     series (>= 1); overflow folds into ``serving.tenant.__other__.steps``."""
     return _int_env("IGG_TELEMETRY_MAX_TENANTS", minimum=1)
+
+
+# -- Live-plane knobs (read per call; docs/observability.md) ------------------
+
+
+def metrics_port_env() -> int | None:
+    """``IGG_METRICS_PORT``: live-plane scrape port (>= 0; 0 = ephemeral).
+    ``None`` = unset — the per-rank HTTP server never starts."""
+    return _int_env("IGG_METRICS_PORT", minimum=0)
+
+
+def metrics_host_env() -> str | None:
+    """``IGG_METRICS_HOST``: live-plane bind address (default loopback —
+    the consumer falls back to ``127.0.0.1`` when unset)."""
+    val = os.environ.get("IGG_METRICS_HOST")
+    return val or None
+
+
+def slo_window_env() -> float | None:
+    """``IGG_SLO_WINDOW_S``: rolling SLO sub-window length in seconds
+    (> 0; unset = the `utils.telemetry.SLO_WINDOW_S_DEFAULT` default)."""
+    return _float_env("IGG_SLO_WINDOW_S", exclusive_minimum=0)

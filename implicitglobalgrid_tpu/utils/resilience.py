@@ -446,6 +446,7 @@ FAULT_KINDS = (
     "init_flake",
     "halo_corrupt",
     "worker_crash",
+    "stall",
     "ckpt_corrupt",
     "ckpt_truncate",
 )
@@ -454,6 +455,7 @@ FAULT_KINDS = (
 _TARGET_PREFIX = {
     "halo_corrupt": "block",
     "worker_crash": "proc",
+    "stall": "proc",
     "ckpt_corrupt": "shard",
     "ckpt_truncate": "shard",
 }
@@ -477,6 +479,14 @@ class FaultInjector:
     * ``worker_crash:stepN[:procP]`` — after time-loop step ``N`` (and after
       that step's checkpoint), process ``P`` (default: the last process)
       exits hard with status 17.  Proves crash→restart-from-checkpoint.
+    * ``stall:stepN[:procP]`` — after time-loop step ``N``, process ``P``
+      (default: the last process) sleeps `STALL_S` seconds before
+      continuing — a transient hang, NOT a crash.  On a communicating grid
+      every rank's loop wedges with it (the neighbors block in the next
+      collective), which is exactly the condition the live plane's
+      scrape-time step-stall rule (`utils.liveplane.StepStallRule`) exists
+      to see from outside the loop; the soak ``live_plane`` scenario
+      drives this end to end.
     * ``ckpt_corrupt:stepN[:shardS]`` — right after the step-``N`` checkpoint
       publishes, a byte of shard file ``S`` (default 0) is flipped WITHOUT
       updating the manifest (process 0 applies it).  Proves the CRC
@@ -499,6 +509,9 @@ class FaultInjector:
 
     #: exit status of an injected worker crash (distinct from real crashes)
     CRASH_STATUS = 17
+
+    #: injected-stall duration in seconds (class attr: tests shrink it)
+    STALL_S = 6.0
 
     @classmethod
     def from_spec(cls, spec: str | None) -> "FaultInjector":
@@ -650,6 +663,28 @@ class FaultInjector:
         sys.stdout.flush()
         os._exit(self.CRASH_STATUS)
 
+    # - stall -
+
+    def maybe_stall(self, step: int) -> None:
+        """After step ``step``: the target process sleeps `STALL_S` seconds.
+
+        The event line lands BEFORE the sleep (the timeline marker an
+        operator correlates the live-plane ``alert.step_stall`` against).
+        """
+        if self.kind != "stall" or self.fired or step != self.step:
+            return
+        want = self.target if self.target is not None else _last_process_index()
+        if _safe_process_index() != want:
+            return
+        self.fired = True
+        _telemetry.event("fault.stall", step=step, sleep_s=self.STALL_S)
+        print(
+            f"[igg.resilience] IGG_FAULT_INJECT(stall): sleeping "
+            f"{self.STALL_S}s after step {step}",
+            file=sys.stderr,
+            flush=True,
+        )
+        time.sleep(self.STALL_S)
 
     # - ckpt_corrupt / ckpt_truncate -
 
@@ -741,18 +776,17 @@ class FaultSet:
         for i in self.injectors:
             i.maybe_crash(step)
 
+    def maybe_stall(self, step: int) -> None:
+        for i in self.injectors:
+            i.maybe_stall(step)
+
     def maybe_damage_checkpoint(self, step_dir: str, step: int) -> None:
         for i in self.injectors:
             i.maybe_damage_checkpoint(step_dir, step)
 
 
 def _last_process_index() -> int:
-    try:
-        import jax
-
-        return jax.process_count() - 1
-    except Exception:
-        return 0
+    return _telemetry.process_count() - 1
 
 
 def _block_interior_index(A, block_rank: int) -> tuple:
@@ -857,10 +891,6 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard",
     otherwise.  With ``IGG_TELEMETRY=0`` (or ``model=None``) the loop takes
     the zero-allocation branch: one ``is not None`` check per step.
     """
-    import jax
-
-    from .compat import trace_annotation
-
     state, it = guard.start(state)
     enabled = guard.enabled  # skip the per-step pipeline entirely when idle
     tele = (
@@ -882,6 +912,32 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard",
             RuntimeWarning,
             stacklevel=2,
         )
+    # Live-plane escalation wiring (docs/observability.md): while this loop
+    # runs, a CRITICAL anomaly alert (from the heartbeat tick or a scrape)
+    # forces an out-of-cadence guard probe instead of scrolling past as a
+    # log line.  Subscribed only for the loop's lifetime.
+    _liveplane = None
+    if tele is not None and enabled:
+        from . import liveplane as _liveplane_mod
+
+        _liveplane = _liveplane_mod
+        _liveplane.subscribe(guard.on_alert)
+    try:
+        return _guarded_loop_body(
+            step_fn, state, nt, it, guard, enabled, sync_every_step,
+            model, tele,
+        )
+    finally:
+        if _liveplane is not None:
+            _liveplane.unsubscribe(guard.on_alert)
+
+
+def _guarded_loop_body(step_fn, state, nt, it, guard, enabled,
+                       sync_every_step, model, tele) -> tuple:
+    import jax
+
+    from .compat import trace_annotation
+
     while it < nt:
         # The ``igg.step`` host span (docs/observability.md): one span per
         # loop iteration — dispatch + sync + guard pipeline, the same wall
@@ -930,8 +986,11 @@ class RunGuard:
     ``checkpoint_keep`` (``IGG_CHECKPOINT_KEEP``) is set — pruning never
     deletes the only integrity-verified generation, (4) fault injection
     (``worker_crash`` — after the checkpoint, so restart resumes exactly at
-    the crash point).  Rollback restores the last good snapshot (in-memory;
-    the disk checkpoint serves cross-process restart) and rewinds ``it``.
+    the crash point — and ``stall``).  Rollback restores the last good
+    snapshot (in-memory; the disk checkpoint serves cross-process restart)
+    and rewinds ``it``.  A pending CRITICAL live-plane alert (`on_alert`,
+    subscribed by `guarded_time_loop`) forces the step-(2) probe out of
+    cadence at the next step.
 
     All knobs resolve kwarg > ``IGG_*`` env > default (the reference's
     configuration tiers).
@@ -997,6 +1056,10 @@ class RunGuard:
         self._last_good: tuple | None = None
         self._last_good_step = 0
         self._injector = injector if injector is not None else get_fault_injector()
+        # Live-plane escalation (utils.liveplane): a pending critical alert
+        # staged by `on_alert`; the next `on_step` consumes it as a forced
+        # out-of-cadence field probe.
+        self._alert: dict | None = None
 
     @property
     def enabled(self) -> bool:
@@ -1034,10 +1097,37 @@ class RunGuard:
             self._last_good_step = it
         return state, it
 
+    def on_alert(self, alert: dict) -> None:
+        """Live-plane subscriber (`utils.liveplane.subscribe`): a CRITICAL
+        alert escalates into the guard machinery — the next `on_step` runs
+        the NaN/Inf field probe immediately, out of cadence, under the
+        configured policy.  Warn-severity alerts stay observability-only.
+        Thread-safe by construction: one reference assignment (the engine
+        may call from the scrape thread)."""
+        if alert.get("severity") == "critical":
+            self._alert = alert
+
     def on_step(self, state: tuple, it: int) -> tuple:
         """Run the per-step guard pipeline; returns ``(state, it)``."""
         state = self._injector.maybe_corrupt(state, it)
-        do_guard = self.guard_every and it % self.guard_every == 0
+        escalated, self._alert = self._alert, None
+        if escalated is not None and _last_process_index() > 0:
+            # Multi-process grid: `check_fields` is a COLLECTIVE, and an
+            # alert is rank-LOCAL — a probe keyed on it would be exactly
+            # the SPMD-divergence (deadlock) class the static analyzer
+            # pins.  The alert event + health view carry the signal;
+            # cross-rank escalation is an operator decision.
+            escalated = None
+        if escalated is not None:
+            _telemetry.event(
+                "guard.alert_probe", step=it, rule=escalated.get("rule"),
+                severity=escalated.get("severity"),
+            )
+            _telemetry.counter("resilience.alert_probes").inc()
+        do_guard = (
+            (self.guard_every and it % self.guard_every == 0)
+            or escalated is not None
+        )
         do_ckpt = self.checkpoint_every and it % self.checkpoint_every == 0
         # Checkpoints must only ever hold guard-passed state: when guarding
         # is on, a checkpoint step that falls between probe points is probed
@@ -1060,6 +1150,7 @@ class RunGuard:
                     self.checkpoint_dir, keep=self.checkpoint_keep
                 )
         self._injector.maybe_crash(it)
+        self._injector.maybe_stall(it)
         return state, it
 
     def _trip(self, state: tuple, it: int, report: FieldReport) -> tuple:

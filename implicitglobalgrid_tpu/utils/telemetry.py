@@ -41,6 +41,7 @@ The registry is process-lifetime state (NOT reset by `finalize_global_grid`
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -62,6 +63,10 @@ __all__ = [
     "prometheus_text",
     "step_loop",
     "teff_bytes",
+    "proc_rss_bytes",
+    "process_count",
+    "note_progress",
+    "last_progress",
     "reset",
 ]
 
@@ -76,6 +81,21 @@ def enabled() -> bool:
 #: reservoir size of every histogram — enough for stable p50/p90/p99 while
 #: bounding a million-step run's memory to a few KiB per metric
 RESERVOIR_SIZE = 512
+
+#: rolling-SLO geometry (docs/observability.md live-plane section): every
+#: histogram additionally keeps a ring of per-window sub-reservoirs so the
+#: live plane can answer "p99 over the last few windows" instead of "p99
+#: since process start".  `SLO_WINDOWS` windows of ``IGG_SLO_WINDOW_S``
+#: seconds each (default `SLO_WINDOW_S_DEFAULT`), `WINDOW_RESERVOIR`
+#: samples per window — bounded however long the run.
+SLO_WINDOWS = 5
+SLO_WINDOW_S_DEFAULT = 30.0
+WINDOW_RESERVOIR = 256
+
+
+def _slo_window_s() -> float:
+    val = _config.slo_window_env()
+    return SLO_WINDOW_S_DEFAULT if val is None else val
 
 
 class Counter:
@@ -104,6 +124,36 @@ class Gauge:
         self.value = float(v)
 
 
+class _Window:
+    """One rolling-SLO sub-window: a bounded sample list over a time slice."""
+
+    __slots__ = ("t0", "count", "total", "samples")
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self.count = 0
+        self.total = 0.0
+        self.samples: list[float] = []
+
+    def add(self, v: float, rng) -> None:
+        self.count += 1
+        self.total += v
+        if len(self.samples) < WINDOW_RESERVOIR:
+            self.samples.append(v)
+        else:
+            j = rng.randrange(self.count)
+            if j < WINDOW_RESERVOIR:
+                self.samples[j] = v
+
+
+def _quantile_of(samples: list[float], q: float) -> float | None:
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
+    return s[idx]
+
+
 class Histogram:
     """Streaming distribution: count/sum/min/max + a bounded reservoir.
 
@@ -111,9 +161,21 @@ class Histogram:
     PRNG — deterministic for a given record sequence (tests), uniform over
     the stream, and bounded at `RESERVOIR_SIZE` samples however many values
     are recorded.  Quantiles in `summary()` come from the reservoir.
+
+    On top of the run-lifetime reservoir, every histogram keeps a ring of
+    rolling sub-windows (`SLO_WINDOWS` windows of ``IGG_SLO_WINDOW_S``
+    seconds, `WINDOW_RESERVOIR` samples each — allocated lazily on first
+    record, so the disabled-mode zero-allocation contract is untouched):
+    `window_summary()` yields live p50/p90/p99 over the last few windows —
+    the ``slo.*`` gauge family and the ``/healthz`` live plane read it
+    (docs/observability.md).  All mutators and readers hold the instance
+    lock, so a scrape thread rendering `prometheus_text` mid-`record` sees
+    a consistent snapshot (the concurrent-scrape pin in
+    ``tests/test_telemetry.py``).
     """
 
-    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng")
+    __slots__ = ("name", "count", "total", "min", "max", "_samples", "_rng",
+                 "_hlock", "_win_cur", "_win_ring", "_win_len")
 
     def __init__(self, name: str):
         import random
@@ -125,40 +187,99 @@ class Histogram:
         self.max = None
         self._samples: list[float] = []
         self._rng = random.Random(0x1661)  # seeded: deterministic reservoirs
+        self._hlock = threading.Lock()
+        self._win_cur: _Window | None = None  # lazy: first record allocates
+        self._win_ring: collections.deque | None = None
+        self._win_len = 0.0
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, now: float | None = None) -> None:
         v = float(v)
-        self.count += 1
-        self.total += v
-        if self.min is None or v < self.min:
-            self.min = v
-        if self.max is None or v > self.max:
-            self.max = v
-        if len(self._samples) < RESERVOIR_SIZE:
-            self._samples.append(v)
-        else:
-            j = self._rng.randrange(self.count)
-            if j < RESERVOIR_SIZE:
-                self._samples[j] = v
+        with self._hlock:
+            self.count += 1
+            self.total += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            if len(self._samples) < RESERVOIR_SIZE:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < RESERVOIR_SIZE:
+                    self._samples[j] = v
+            # rolling-SLO window ring (lazy; ``now`` injectable for tests)
+            if now is None:
+                now = time.monotonic()
+            w = self._win_cur
+            if w is None:
+                self._win_len = _slo_window_s()
+                self._win_ring = collections.deque(maxlen=SLO_WINDOWS - 1)
+                w = self._win_cur = _Window(now)
+            elif now - w.t0 >= self._win_len:
+                self._win_ring.append(w)
+                self._win_len = _slo_window_s()  # re-read per rollover
+                w = self._win_cur = _Window(now)
+            w.add(v, self._rng)
 
     def quantile(self, q: float) -> float | None:
-        if not self._samples:
+        with self._hlock:
+            return _quantile_of(self._samples, q)
+
+    def window_summary(self, now: float | None = None) -> dict | None:
+        """Live ``{window_s, windows, count, p50, p90, p99}`` over the last
+        `SLO_WINDOWS` windows, or None before the first record.  Windows
+        older than the rolling horizon (``SLO_WINDOWS * window_s`` behind
+        ``now``) are excluded, so a long-idle histogram goes quiet instead
+        of replaying stale quantiles forever."""
+        with self._hlock:
+            return self._window_summary_locked(now)
+
+    def _window_summary_locked(self, now: float | None = None) -> dict | None:
+        if self._win_cur is None:
             return None
-        s = sorted(self._samples)
-        idx = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
-        return s[idx]
+        if now is None:
+            now = time.monotonic()
+        horizon = now - SLO_WINDOWS * self._win_len
+        live = [
+            w
+            for w in (*self._win_ring, self._win_cur)
+            if w.t0 >= horizon
+        ]
+        samples: list[float] = []
+        count = 0
+        total = 0.0
+        for w in live:
+            samples.extend(w.samples)
+            count += w.count
+            total += w.total
+        if not count:
+            return None
+        return {
+            "window_s": self._win_len,
+            "windows": len(live),
+            "count": count,
+            "mean": total / count,
+            "p50": _quantile_of(samples, 0.50),
+            "p90": _quantile_of(samples, 0.90),
+            "p99": _quantile_of(samples, 0.99),
+        }
 
     def summary(self) -> dict:
-        return {
-            "count": self.count,
-            "sum": self.total,
-            "min": self.min,
-            "max": self.max,
-            "mean": (self.total / self.count) if self.count else None,
-            "p50": self.quantile(0.50),
-            "p90": self.quantile(0.90),
-            "p99": self.quantile(0.99),
-        }
+        with self._hlock:
+            out = {
+                "count": self.count,
+                "sum": self.total,
+                "min": self.min,
+                "max": self.max,
+                "mean": (self.total / self.count) if self.count else None,
+                "p50": _quantile_of(self._samples, 0.50),
+                "p90": _quantile_of(self._samples, 0.90),
+                "p99": _quantile_of(self._samples, 0.99),
+            }
+            win = self._window_summary_locked()
+            if win is not None:
+                out["window"] = win
+            return out
 
 
 class _Noop:
@@ -175,7 +296,7 @@ class _Noop:
     def set(self, v: float) -> None:
         pass
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, now: float | None = None) -> None:
         pass
 
 
@@ -278,7 +399,7 @@ def tenant_counter(tenant: str) -> Counter | _Noop:
 
 def reset() -> None:
     """Drop every metric and close the event-log descriptors (test hook)."""
-    global _rank_hint
+    global _rank_hint, _progress
     with _lock:
         _counters.clear()
         _gauges.clear()
@@ -290,6 +411,70 @@ def reset() -> None:
                 pass
         _event_fds.clear()
     _rank_hint = None
+    _progress = None
+
+
+# -- Run progress (the live plane's last-step-age source) ---------------------
+
+# The newest completed unit of work of this process — ``{wall, kind, step,
+# init, done}`` — written by the instrumented loops (one dict write per
+# step) and read by `utils.liveplane`'s ``/healthz`` endpoint and its
+# step-stall anomaly rule.  ``init=True`` marks the pre-first-step phase
+# (bring-up + first compile: a stall alarm there would be noise);
+# ``done=True`` marks a completed run (the server outlives the loop — age
+# keeps growing, but nothing is stalled).
+_progress: dict | None = None
+
+
+def note_progress(kind: str, step: int, *, init: bool = False,
+                  done: bool = False) -> None:
+    """Record the newest completed work unit (see `_progress`)."""
+    global _progress
+    _progress = {
+        "wall": time.time(),
+        "kind": kind,
+        "step": int(step),
+        "init": init,
+        "done": done,
+    }
+
+
+def last_progress() -> dict | None:
+    """The newest progress record plus its ``age_s``, or None before any."""
+    p = _progress
+    if p is None:
+        return None
+    out = dict(p)
+    out["age_s"] = time.time() - p["wall"]
+    return out
+
+
+def proc_rss_bytes() -> int | None:
+    """This process's resident set size in bytes, or None when unknown.
+
+    ``/proc/self/statm`` (Linux) is the primary source; the
+    ``resource.getrusage`` peak-RSS fallback covers platforms without
+    procfs (a PEAK, not current — good enough for the growth-rule and
+    leak-triage consumers, and graceful absence beats a wrong number).
+    """
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGESIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        if not maxrss:
+            return None
+        # ru_maxrss is KILOBYTES on Linux/BSD but BYTES on macOS — the
+        # platform this fallback exists for (no procfs there)
+        return int(maxrss) if sys.platform == "darwin" else int(maxrss) * 1024
+    except Exception:
+        return None
 
 
 # -- Identity tagging ---------------------------------------------------------
@@ -323,6 +508,18 @@ def _proc_index() -> int:
     except Exception:
         pass
     return _rank_hint if _rank_hint is not None else 0
+
+
+def process_count() -> int:
+    """Process count without touching an absent runtime (1 then) — the ONE
+    probe behind every "is this multi-process" gate (the SPMD-divergence
+    guards in `resilience.RunGuard` and `serving.ServingLoop` key on it)."""
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
 
 
 def _grid_coords() -> list[int] | None:
@@ -532,6 +729,13 @@ class _StepLoop:
         # per heartbeat interval; docs/observability.md straggler section)
         self._win_sum = 0.0
         self._win_n = 0
+        # Live plane (utils.liveplane): bring the per-rank scrape server up
+        # (no-op unless IGG_METRICS_PORT is set) and mark the pre-first-step
+        # phase so the step-stall rule ignores bring-up/compile time.
+        note_progress(model, start_step, init=True)
+        from . import liveplane as _liveplane
+
+        _liveplane.ensure_server()
         event("run.start", model=model, start_step=start_step,
               total_steps=total_steps, bytes_per_step=bytes_per_step)
 
@@ -551,6 +755,7 @@ class _StepLoop:
             gbs = self.bytes_per_step / dt / 1e9
             self._teff.record(gbs)
             self._teff_g.set(gbs)
+        note_progress(self.model, it)
         if self.heartbeat_every and it % self.heartbeat_every == 0:
             # The skew probe is a COLLECTIVE: every rank must run it at the
             # same step (hence outside the rank-0 gate below; single-process
@@ -562,6 +767,16 @@ class _StepLoop:
                 skew = _tracing.skew_probe(self._win_sum / self._win_n)
             self._win_sum = 0.0
             self._win_n = 0
+            # Live-plane heartbeat tick on EVERY rank (strictly local — no
+            # collectives): publish the proc.rss_bytes gauge and the slo.*
+            # windowed quantiles, then evaluate the anomaly rules
+            # (docs/observability.md live-plane section).
+            rss = proc_rss_bytes()
+            if rss is not None:
+                gauge("proc.rss_bytes").set(rss)
+            from . import liveplane as _liveplane
+
+            _liveplane.heartbeat_tick(model=self.model)
             if self._is_rank0:
                 import sys
 
@@ -583,6 +798,7 @@ class _StepLoop:
                       **_heartbeat_context(skew))
 
     def finish(self, it: int) -> None:
+        note_progress(self.model, it, done=True)
         event("run.complete", model=self.model, step=it)
 
 
@@ -610,6 +826,11 @@ def _heartbeat_context(skew: dict | None) -> dict:
             "active_members": active,
             "queue_depth": gauge_value("serving.queue_depth"),
         }
+    # The live plane's scrape endpoint, when one is serving: the rank-0
+    # heartbeat is the discovery channel for an ephemeral (port 0) bind.
+    port = gauge_value("liveplane.port")
+    if port is not None:
+        ctx["liveplane"] = {"port": int(port)}
     return ctx
 
 

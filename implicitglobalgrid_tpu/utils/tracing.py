@@ -70,6 +70,8 @@ __all__ = [
     "trace_span",
     "span_records",
     "span_summary",
+    "open_spans",
+    "span_stats",
     "record_clock_sync",
     "clock_sync",
     "dump_trace",
@@ -116,9 +118,38 @@ def _get_ring(cap: int) -> collections.deque:
         return _ring
 
 
+# Per-thread stacks of the spans currently EXECUTING — the spans a crash
+# bundle most wants (the closed-span ring by definition misses them) and
+# what the live plane's ``/spans`` endpoint shows as in-flight.  Keyed by
+# thread ident; list append/pop are GIL-atomic, so enter/exit pay no lock.
+_open_stacks: dict[int, list] = {}
+
+
+def open_spans() -> list[dict]:
+    """Every thread's currently-open spans, innermost last, each marked
+    ``open: true`` with its age-so-far as ``dur`` (readers must not
+    mistake an in-flight span for a completed one)."""
+    now = time.perf_counter()
+    out = []
+    for ident, stack in list(_open_stacks.items()):
+        for name, t0, tags in list(stack):
+            rec = {
+                "name": name,
+                "t0": t0,
+                "dur": now - t0,
+                "open": True,
+                "thread": ident,
+            }
+            if tags:
+                rec["args"] = tags
+            out.append(rec)
+    return out
+
+
 class _Span:
     """One live span.  Records itself into the ring on exit; re-entrant
-    use records one span per enter/exit pair."""
+    use records one span per enter/exit pair.  While executing it sits on
+    this thread's open-span stack (see `open_spans`)."""
 
     __slots__ = ("name", "tags", "t0")
 
@@ -128,10 +159,21 @@ class _Span:
 
     def __enter__(self) -> "_Span":
         self.t0 = time.perf_counter()
+        ident = threading.get_ident()
+        stack = _open_stacks.get(ident)
+        if stack is None:
+            stack = _open_stacks[ident] = []
+        stack.append((self.name, self.t0, self.tags))
         return self
 
     def __exit__(self, *exc) -> None:
         t1 = time.perf_counter()
+        ident = threading.get_ident()
+        stack = _open_stacks.get(ident)
+        if stack:
+            stack.pop()
+            if not stack:
+                _open_stacks.pop(ident, None)  # no thread-lifetime leak
         _get_ring(_ring_capacity()).append(
             (self.name, self.t0, t1 - self.t0, self.tags)
         )
@@ -196,6 +238,39 @@ def span_summary() -> dict:
         }
         for name, (c, total, mx) in sorted(agg.items())
     }
+
+
+def span_stats(span_lists: Sequence[Sequence[dict]]) -> dict:
+    """``{span name: {count, total_s, mean_s, p50_s, p99_s, max_s}}`` over
+    any number of span-record lists (the `span_records` / ``trace.p*.json``
+    schema) — the aggregation behind ``scripts/igg_trace.py summarize``.
+    Quantiles are nearest-rank over ALL matching spans' durations (no
+    reservoir: a dump is already bounded by the ring).  Open spans
+    (``open: true``) are excluded — their durations are ages, not totals.
+    """
+    durs: dict[str, list[float]] = {}
+    for spans in span_lists:
+        for s in spans:
+            if s.get("open"):
+                continue
+            durs.setdefault(s["name"], []).append(float(s["dur"]))
+    out = {}
+    for name in sorted(durs):
+        ds = sorted(durs[name])
+        n = len(ds)
+
+        def q(frac: float) -> float:
+            return ds[min(n - 1, max(0, round(frac * (n - 1))))]
+
+        out[name] = {
+            "count": n,
+            "total_s": sum(ds),
+            "mean_s": sum(ds) / n,
+            "p50_s": q(0.50),
+            "p99_s": q(0.99),
+            "max_s": ds[-1],
+        }
+    return out
 
 
 # -- clock sync ---------------------------------------------------------------
@@ -679,7 +754,10 @@ def dump_flight_recorder(reason: str, **info: Any) -> str | None:
             "info": info,
             "config": _active_config(),
             "metrics": _telemetry.snapshot(),
-            "spans": span_records(),
+            # Closed ring PLUS the spans currently executing (``open:
+            # true``, every thread): the span you most want at crash time
+            # is the one that was in flight when the run died.
+            "spans": span_records() + open_spans(),
         }
         try:
             line = json.dumps(bundle, default=str) + "\n"
@@ -707,10 +785,12 @@ def read_flight_bundles(path: str | os.PathLike) -> list[dict]:
 
 
 def reset() -> None:
-    """Drop the span ring, clock sync and probe caches (test hook)."""
+    """Drop the span ring, open stacks, clock sync and probe caches
+    (test hook)."""
     global _ring, _ring_cap, _clock_sync
     with _lock:
         _ring = None
         _ring_cap = 0
+    _open_stacks.clear()
     _clock_sync = None
     _skew_cache.clear()

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Cluster view over per-rank live-plane endpoints (docs/observability.md).
+
+Each rank's ``IGG_METRICS_PORT`` server exposes ``/metrics`` (Prometheus
+text) and ``/healthz`` (JSON).  This tool scrapes any set of them into ONE
+cluster view: a merged exposition with ``rank`` labels, and a terminal
+summary table (per-rank step p50/p99, T_eff, skew, last-step age, alerts)
+— the live answer to "which rank is slow" without waiting for a trace
+merge::
+
+    python scripts/igg_top.py host0:9100 host1:9100
+    python scripts/igg_top.py --dir $IGG_TELEMETRY_DIR       # liveplane.p*.json
+    python scripts/igg_top.py --endpoints-file endpoints.txt # one host:port/line
+    python scripts/igg_top.py --dir RUN --watch 2            # refresh every 2s
+    python scripts/igg_top.py --dir RUN --prom merged.prom   # merged exposition
+
+``--dir`` reads the ``liveplane.p<rank>.json`` endpoint files each rank
+writes into ``IGG_TELEMETRY_DIR`` when it binds an ephemeral port — the
+discovery channel for port-0 runs (the soak ``live_plane`` scenario uses
+exactly this).  Exit codes: 0 all endpoints scraped, 1 any endpoint
+unreachable, 2 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SCRAPE_TIMEOUT_S = 3.0
+
+_SAMPLE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+# ---------------------------------------------------------------------------
+# endpoint discovery
+# ---------------------------------------------------------------------------
+
+
+def discover_endpoints(args) -> list[str]:
+    """``host:port`` list from positional args / --endpoints-file / --dir."""
+    endpoints = list(args.endpoints)
+    if args.endpoints_file:
+        with open(args.endpoints_file) as f:
+            endpoints += [
+                line.strip()
+                for line in f
+                if line.strip() and not line.startswith("#")
+            ]
+    if args.dir:
+        files = sorted(glob.glob(os.path.join(args.dir, "liveplane.p*.json")))
+        if not files:
+            raise FileNotFoundError(
+                f"{args.dir}: no liveplane.p*.json endpoint files (is the "
+                f"run up with IGG_METRICS_PORT and IGG_TELEMETRY_DIR set?)"
+            )
+        for path in files:
+            with open(path) as f:
+                doc = json.load(f)
+            endpoints.append(f"{doc['host']}:{doc['port']}")
+    if not endpoints:
+        raise ValueError(
+            "no endpoints: pass host:port arguments, --endpoints-file or "
+            "--dir"
+        )
+    return endpoints
+
+
+def scrape(endpoint: str) -> dict:
+    """One rank's ``{health, metrics}`` (raises on an unreachable rank)."""
+    with urllib.request.urlopen(
+        f"http://{endpoint}/healthz", timeout=SCRAPE_TIMEOUT_S
+    ) as r:
+        health = json.load(r)
+    with urllib.request.urlopen(
+        f"http://{endpoint}/metrics", timeout=SCRAPE_TIMEOUT_S
+    ) as r:
+        metrics = r.read().decode()
+    return {"endpoint": endpoint, "health": health, "metrics": metrics}
+
+
+# ---------------------------------------------------------------------------
+# merged exposition
+# ---------------------------------------------------------------------------
+
+
+def merge_expositions(per_rank: dict[int, str]) -> str:
+    """Join per-rank Prometheus text into one exposition with rank labels.
+
+    Sample lines gain (or extend) a label set with ``rank="N"``; each
+    metric's ``# TYPE`` header is emitted once.  The output stays valid
+    text format 0.0.4, so one igg_top scrape can stand in for N direct
+    scrapes in any collector.
+    """
+    types: dict[str, str] = {}
+    samples: list[tuple[str, str]] = []
+    for rank in sorted(per_rank):
+        for line in per_rank[rank].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) == 4:
+                    types.setdefault(parts[2], parts[3])
+                continue
+            if line.startswith("#"):
+                continue
+            m = _SAMPLE.match(line)
+            if not m:
+                continue
+            name, labels, value = m.groups()
+            inner = labels[1:-1] if labels else ""
+            inner = f'rank="{rank}"' + (f",{inner}" if inner else "")
+            samples.append((name, f"{name}{{{inner}}} {value}"))
+    out: list[str] = []
+    emitted: set[str] = set()
+    for name, line in samples:
+        if name not in emitted:
+            emitted.add(name)
+            t = types.get(name)
+            if t:
+                out.append(f"# TYPE {name} {t}")
+        out.append(line)
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# summary table
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v, scale=1.0, suffix="", nd=1) -> str:
+    if v is None:
+        return "-"
+    return f"{v * scale:.{nd}f}{suffix}"
+
+
+def summary_rows(healths: dict[int, dict]) -> list[dict]:
+    """One summary row per rank from its ``/healthz`` document."""
+    rows = []
+    for rank in sorted(healths):
+        h = healths[rank]
+        slo = h.get("slo", {})
+        step = next(
+            (s for n, s in slo.items() if n.endswith("step_seconds")), {}
+        )
+        teff = next(
+            (s for n, s in slo.items() if n.endswith("t_eff_gbs")), {}
+        )
+        active = h.get("alerts", {}).get("active", [])
+        rows.append(
+            {
+                "rank": rank,
+                "ok": h.get("ok"),
+                "coords": h.get("coords"),
+                "step": h.get("last_step", {}).get("step"),
+                "age_s": h.get("last_step", {}).get("age_s"),
+                "p50_ms": (step.get("p50") or 0) * 1e3 if step else None,
+                "p99_ms": (step.get("p99") or 0) * 1e3 if step else None,
+                "teff_gbs": teff.get("p50") if teff else None,
+                "skew": h.get("skew", {}).get("step_seconds_max_over_min"),
+                "alerts": ",".join(
+                    f"{a['rule']}({a['severity']})" for a in active
+                ) or "-",
+            }
+        )
+    return rows
+
+
+def render_table(rows: list[dict]) -> str:
+    head = (
+        f"{'rank':>4} {'ok':>4} {'step':>8} {'age':>8} {'p50':>9} "
+        f"{'p99':>9} {'T_eff':>9} {'skew':>6}  alerts"
+    )
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        lines.append(
+            f"{r['rank']:>4} {('ok' if r['ok'] else 'ALRT'):>4} "
+            f"{r['step'] if r['step'] is not None else '-':>8} "
+            f"{_fmt(r['age_s'], suffix='s'):>8} "
+            f"{_fmt(r['p50_ms'], suffix='ms'):>9} "
+            f"{_fmt(r['p99_ms'], suffix='ms'):>9} "
+            f"{_fmt(r['teff_gbs'], suffix='GB', nd=2):>9} "
+            f"{_fmt(r['skew'], nd=2):>6}  {r['alerts']}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def scrape_cluster(endpoints: list[str]) -> tuple[dict, list[str]]:
+    """``({rank: scrape result}, [unreachable endpoint messages])``."""
+    by_rank: dict[int, dict] = {}
+    errors: list[str] = []
+    for i, ep in enumerate(endpoints):
+        try:
+            res = scrape(ep)
+        except Exception as e:
+            errors.append(f"{ep}: {type(e).__name__}: {e}")
+            continue
+        rank = res["health"].get("rank", i)
+        by_rank[rank] = res
+    return by_rank, errors
+
+
+def one_view(args, endpoints: list[str]) -> int:
+    by_rank, errors = scrape_cluster(endpoints)
+    healths = {r: res["health"] for r, res in by_rank.items()}
+    rows = summary_rows(healths)
+    print(
+        f"igg_top — {len(by_rank)}/{len(endpoints)} rank(s) at "
+        f"{time.strftime('%H:%M:%S')}"
+    )
+    print(render_table(rows))
+    for msg in errors:
+        print(f"igg_top: UNREACHABLE {msg}", file=sys.stderr)
+    if args.prom:
+        merged = merge_expositions(
+            {r: res["metrics"] for r, res in by_rank.items()}
+        )
+        with open(args.prom, "w", encoding="utf-8") as f:
+            f.write(merged)
+        print(f"igg_top: wrote merged exposition {args.prom}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"ranks": healths, "errors": errors}, default=str))
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="igg_top.py",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("endpoints", nargs="*", help="host:port endpoints")
+    ap.add_argument("--endpoints-file", help="file of host:port lines")
+    ap.add_argument("--dir", help="telemetry dir holding liveplane.p*.json")
+    ap.add_argument("--watch", type=float, metavar="SECONDS",
+                    help="refresh the view every SECONDS until interrupted")
+    ap.add_argument("--prom", help="write the merged rank-labeled exposition")
+    ap.add_argument("--json", action="store_true",
+                    help="also print the cluster health view as one JSON line")
+    args = ap.parse_args(argv)
+    try:
+        endpoints = discover_endpoints(args)
+    except (OSError, ValueError) as e:
+        print(f"igg_top: {e}", file=sys.stderr)
+        return 2
+    if not args.watch:
+        return one_view(args, endpoints)
+    try:
+        while True:
+            print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+            one_view(args, endpoints)
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

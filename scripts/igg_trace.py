@@ -9,8 +9,12 @@ their honesty bound in ``otherData.clock_alignment``)::
     python scripts/igg_trace.py merge RUN_DIR -o merged.json
     python scripts/igg_trace.py merge trace.p0.json trace.p1.json -o m.json
     python scripts/igg_trace.py validate merged.json
+    python scripts/igg_trace.py summarize RUN_DIR
 
-Load ``merged.json`` at https://ui.perfetto.dev (or chrome://tracing).
+``summarize`` prints a per-span-name aggregate table (count, total,
+p50/p99, max) over one or more per-rank dumps — the quick look that no
+longer requires loading Perfetto.  Load ``merged.json`` at
+https://ui.perfetto.dev (or chrome://tracing).
 Exit codes: 0 ok, 1 invalid trace, 2 bad input/usage.
 """
 
@@ -76,6 +80,43 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def render_span_table(stats: dict) -> str:
+    """Fixed-width aggregate table (golden-pinned by tests/test_tracing.py:
+    change the format deliberately and update the golden)."""
+    head = (
+        f"{'span':<32} {'count':>7} {'total_ms':>10} {'mean_ms':>9} "
+        f"{'p50_ms':>9} {'p99_ms':>9} {'max_ms':>9}"
+    )
+    lines = [head, "-" * len(head)]
+    for name, s in stats.items():
+        lines.append(
+            f"{name:<32} {s['count']:>7} {s['total_s'] * 1e3:>10.3f} "
+            f"{s['mean_s'] * 1e3:>9.3f} {s['p50_s'] * 1e3:>9.3f} "
+            f"{s['p99_s'] * 1e3:>9.3f} {s['max_s'] * 1e3:>9.3f}"
+        )
+    return "\n".join(lines)
+
+
+def cmd_summarize(args) -> int:
+    from implicitglobalgrid_tpu.utils import tracing
+
+    try:
+        paths = _expand(args.inputs)
+        docs = [tracing._load_rank_trace(os.fspath(p)) for p in paths]
+    except (OSError, ValueError) as e:
+        print(f"igg_trace: {e}", file=sys.stderr)
+        return 2
+    stats = tracing.span_stats([d["spans"] for d in docs])
+    if args.json:
+        print(json.dumps(stats))
+        return 0
+    ranks = sorted(d["rank"] for d in docs)
+    nspans = sum(len(d["spans"]) for d in docs)
+    print(f"# {nspans} span(s) across rank(s) {ranks}")
+    print(render_span_table(stats))
+    return 0
+
+
 def cmd_validate(args) -> int:
     from implicitglobalgrid_tpu.utils import tracing
 
@@ -107,8 +148,19 @@ def main(argv=None) -> int:
                     help="merged trace path ('-' = stdout)")
     vp = sub.add_parser("validate", help="check a merged Chrome trace")
     vp.add_argument("trace")
+    sp = sub.add_parser(
+        "summarize", help="per-span-name aggregate table over rank dumps"
+    )
+    sp.add_argument("inputs", nargs="+",
+                    help="trace.pN.json files and/or directories")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable stats instead of the table")
     args = ap.parse_args(argv)
-    return cmd_merge(args) if args.cmd == "merge" else cmd_validate(args)
+    if args.cmd == "merge":
+        return cmd_merge(args)
+    if args.cmd == "summarize":
+        return cmd_summarize(args)
+    return cmd_validate(args)
 
 
 if __name__ == "__main__":

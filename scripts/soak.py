@@ -51,9 +51,20 @@ telemetry snapshot instead of private tallies.
   The orchestrator re-verifies the ``serving.*`` event schema
   (docs/observability.md) from the JSONL log.
 
-``--quick`` runs the ``elastic_failover`` drill plus the ``serving`` smoke
-at small size — the fast smoke path (registered next to the tier-1 command
-in docs/testing.md).
+* ``live_plane`` — the live-telemetry drill (ISSUE 11): a real 2-process
+  gloo pair runs with ``IGG_METRICS_PORT=0`` (ephemeral per-rank scrape
+  servers, discovered via the ``liveplane.p*.json`` endpoint files) and a
+  ``stall:stepN:proc1`` fault armed.  The orchestrator scrapes BOTH
+  ranks' ``/metrics`` + ``/healthz`` mid-run, renders one
+  ``scripts/igg_top.py`` cluster view (merged rank-labeled exposition +
+  per-rank summary), and verifies the injected stall fires a rank-tagged
+  ``alert.step_stall`` on the stalled rank — visible in the scraped
+  health view WHILE the loop is wedged (the scrape-time rule evaluation)
+  and in that rank's event log afterwards.
+
+``--quick`` runs the ``elastic_failover`` drill, the ``serving`` smoke
+and the ``live_plane`` drill at small size — the fast smoke path
+(registered next to the tier-1 command in docs/testing.md).
 """
 
 from __future__ import annotations
@@ -69,7 +80,7 @@ REPO = os.path.dirname(HERE)
 
 CRASH_STATUS = 17  # FaultInjector.CRASH_STATUS
 SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash",
-             "elastic_failover", "serving")
+             "elastic_failover", "serving", "live_plane")
 
 
 def _free_port() -> int:
@@ -283,6 +294,213 @@ def child_serving_main(args) -> int:
     igg.finalize_global_grid()
     print("SOAK SERVING OK", flush=True)
     return 0
+
+
+def child_live_main(args) -> int:
+    """One worker of the live-plane drill: a 2-process gloo member running
+    instrumented diffusion with the scrape server on an ephemeral port.
+    The orchestrator injects the stall (``IGG_FAULT_INJECT``), scrapes the
+    endpoints mid-run and does all verification; this child just runs."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import numpy as np
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.utils import resilience
+    from implicitglobalgrid_tpu.utils.telemetry import teff_bytes
+
+    pid = args.pair_id
+    resilience.arm_watchdog(max(30, args.timeout - 40), exit=True)
+    igg.init_global_grid(
+        args.nx, args.nx, args.nx, quiet=(pid != 0),
+        init_distributed=True,
+        distributed_kwargs=dict(
+            coordinator_address=f"127.0.0.1:{args.port}",
+            num_processes=2,
+            process_id=pid,
+        ),
+    )
+    state, params = diffusion3d.setup(args.nx, args.nx, args.nx,
+                                      init_grid=False)
+    step = diffusion3d.make_step(params)
+    # No guard cadence needed: the armed stall injector alone enables the
+    # per-step pipeline (RunGuard.enabled), and the live plane rides the
+    # telemetry hooks.
+    guard = resilience.RunGuard(names=("T", "Cp"))
+    state = resilience.guarded_time_loop(
+        step, state, args.steps, guard=guard, sync_every_step=True,
+        model="diffusion3d", bytes_per_step=teff_bytes(state[:1]),
+    )
+    # this rank's shards only: the global array spans both processes
+    for shard in state[0].addressable_shards:
+        assert np.isfinite(np.asarray(shard.data)).all()
+    igg.finalize_global_grid()
+    print("SOAK CHILD OK", flush=True)
+    return 0
+
+
+def supervise_live_plane(args) -> bool:
+    """The live-plane drill (module docstring): spawn the pair, discover
+    the ephemeral endpoints, scrape mid-run, catch the stall alert live,
+    render the igg_top cluster view, then verify the event logs."""
+    import shutil
+    import time as _time
+    import urllib.request
+
+    if HERE not in sys.path:
+        sys.path.insert(0, HERE)
+    import igg_top
+
+    workdir = args.workdir
+    tele_dir = os.path.join(workdir, "telemetry_live")
+    shutil.rmtree(tele_dir, ignore_errors=True)
+    mid = max(2, args.steps // 2)
+    port = _free_port()
+    env = _elastic_env(
+        {
+            "IGG_TELEMETRY": "1",
+            "IGG_TELEMETRY_DIR": tele_dir,
+            "IGG_METRICS_PORT": "0",
+            "IGG_HEARTBEAT_EVERY": "2",
+            "IGG_FAULT_INJECT": f"stall:step{mid}:proc1",
+        }
+    )
+    logs = [
+        open(os.path.join(workdir, f"live_pair{pid}.log"), "w+")
+        for pid in range(2)
+    ]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--live-child",
+             "--steps", str(args.steps), "--nx", str(args.nx),
+             "--pair-id", str(pid), "--port", str(port),
+             "--timeout", str(args.timeout)],
+            env=env, stdout=logs[pid], stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+
+    def _fail(detail: str) -> bool:
+        for q in procs:
+            q.kill()
+        for f in logs:
+            f.flush()
+            f.seek(0)
+            print(f.read(), file=sys.stderr)
+            f.close()
+        return _report("live_plane", False, detail)
+
+    # (1) endpoint discovery: both ranks publish liveplane.p<rank>.json
+    # once their loops (and scrape servers) are up.
+    deadline = _time.monotonic() + args.timeout
+    endpoints = None
+    while _time.monotonic() < deadline:
+        try:
+            endpoints = igg_top.discover_endpoints(
+                argparse.Namespace(endpoints=[], endpoints_file=None,
+                                   dir=tele_dir)
+            )
+            if len(endpoints) == 2:
+                break
+        except (OSError, ValueError):
+            pass
+        if any(q.poll() is not None for q in procs):
+            return _fail("a child exited before publishing its endpoint")
+        _time.sleep(0.1)
+    if not endpoints or len(endpoints) != 2:
+        return _fail(f"endpoint discovery timed out ({endpoints})")
+
+    # (2) scrape both ranks mid-run until the injected stall's alert shows
+    # in the STALLED rank's live health view (the scrape-time rule firing
+    # while the loop is wedged), collecting /metrics along the way.
+    metrics_ok = {0: False, 1: False}
+    stall_seen = None
+    cluster = None
+    while _time.monotonic() < deadline:
+        by_rank, _errors = igg_top.scrape_cluster(endpoints)
+        for rank, res in by_rank.items():
+            if "igg_diffusion3d_steps_total" in res["metrics"]:
+                metrics_ok[rank] = True
+            alerts = res["health"].get("alerts", {})
+            for a in alerts.get("active", []) + alerts.get("recent", []):
+                if a.get("rule") == "step_stall" and rank == 1:
+                    stall_seen = a
+                    cluster = by_rank
+        if stall_seen and all(metrics_ok.values()):
+            break
+        if all(q.poll() is not None for q in procs):
+            break
+        _time.sleep(0.1)
+    for q in procs:
+        try:
+            q.wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            return _fail("pair did not finish after the stall")
+    if any(q.returncode != 0 for q in procs):
+        return _fail(f"child rc={[q.returncode for q in procs]}")
+    for f in logs:
+        f.close()
+    if not all(metrics_ok.values()):
+        return _report("live_plane", False,
+                       f"/metrics never scraped from both ranks {metrics_ok}")
+    if stall_seen is None:
+        return _report(
+            "live_plane", False,
+            "alert.step_stall never appeared in rank 1's scraped /healthz "
+            "during the injected stall",
+        )
+
+    # (3) ONE igg_top cluster view from the mid-run scrape: the merged
+    # exposition must carry BOTH ranks' samples under rank labels, and the
+    # summary table one row per rank.
+    merged = igg_top.merge_expositions(
+        {r: res["metrics"] for r, res in cluster.items()}
+    )
+    if 'rank="0"' not in merged or 'rank="1"' not in merged:
+        return _report("live_plane", False,
+                       "merged exposition lacks per-rank labels")
+    rows = igg_top.summary_rows(
+        {r: res["health"] for r, res in cluster.items()}
+    )
+    if len(rows) != 2:
+        return _report("live_plane", False, f"cluster view rows: {rows}")
+
+    # (4) the event-log acceptance: the stall fired a rank-tagged
+    # alert.step_stall on the RIGHT rank (the event log is the durable
+    # record the scraped view previewed), next to the fault marker.
+    from implicitglobalgrid_tpu.utils.telemetry import read_events
+
+    p1 = os.path.join(tele_dir, "events.p1.jsonl")
+    if not os.path.isfile(p1):
+        return _report("live_plane", False, f"no {p1}")
+    events = read_events(p1)
+    fault = [e for e in events if e.get("type") == "fault.stall"]
+    alerts = [e for e in events if e.get("type") == "alert.step_stall"]
+    if not fault:
+        return _report("live_plane", False, "no fault.stall event on rank 1")
+    if not any(e.get("rank") == 1 for e in alerts):
+        return _report(
+            "live_plane", False,
+            f"no rank-1-tagged alert.step_stall event (saw "
+            f"{[(e.get('type'), e.get('rank')) for e in events][:20]})",
+        )
+    return _report(
+        "live_plane", True,
+        f"2 ranks scraped live; stall at step {mid} -> alert.step_stall on "
+        f"rank 1 (age {stall_seen['evidence'].get('age_s')}s > deadline "
+        f"{stall_seen['evidence'].get('deadline_s')}s) seen in /healthz "
+        f"mid-stall AND in events.p1.jsonl; igg_top merged view spans both "
+        f"ranks",
+    )
 
 
 def _verify_serving_events(tele_dir: str) -> tuple[bool, str]:
@@ -654,7 +872,10 @@ def orchestrate(args) -> int:
     # The elastic drill carries its own oracle (a different topology); the
     # shared 8-device baseline is only needed by the other scenarios.
     baseline = None
-    if any(s not in ("elastic_failover", "serving") for s in args.scenarios):
+    if any(
+        s not in ("elastic_failover", "serving", "live_plane")
+        for s in args.scenarios
+    ):
         proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
         if proc.returncode != 0:
             print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
@@ -666,6 +887,10 @@ def orchestrate(args) -> int:
     for scenario in args.scenarios:
         if scenario == "elastic_failover":
             if not supervise_elastic_failover(args):
+                failures += 1
+            continue
+        if scenario == "live_plane":
+            if not supervise_live_plane(args):
                 failures += 1
             continue
         if scenario == "serving":
@@ -762,15 +987,17 @@ def main() -> int:
     ap.add_argument(
         "--quick", action="store_true",
         help="fast smoke path: the elastic_failover drill (crash -> "
-        "fallback past the corrupt generation -> shrunk-topology restart) "
-        "plus the batched-serving loop smoke (mid-flight admit/retire, "
-        "per-member convergence masking) at small size — the CI lane "
-        "registered in docs/testing.md",
+        "fallback past the corrupt generation -> shrunk-topology restart), "
+        "the batched-serving loop smoke (mid-flight admit/retire, "
+        "per-member convergence masking) and the live_plane drill "
+        "(mid-run endpoint scrape + stall alert) at small size — the CI "
+        "lane registered in docs/testing.md",
     )
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--elastic-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--serving-child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--live-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
     ap.add_argument("--distributed", action="store_true", help=argparse.SUPPRESS)
@@ -784,10 +1011,12 @@ def main() -> int:
         return child_elastic_main(args)
     if args.serving_child:
         return child_serving_main(args)
+    if args.live_child:
+        return child_live_main(args)
     if args.child:
         return child_main(args)
     if args.quick:
-        args.scenarios = ["elastic_failover", "serving"]
+        args.scenarios = ["elastic_failover", "serving", "live_plane"]
         args.steps = min(args.steps, 6)
         args.timeout = min(args.timeout, 300)
     return orchestrate(args)

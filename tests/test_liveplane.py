@@ -1,0 +1,552 @@
+"""Live telemetry plane tests (ISSUE 11; docs/observability.md).
+
+Covers the per-rank scrape server (endpoint catalog, the /metrics
+byte-identity with `dump_metrics`, the IGG_TELEMETRY=0 never-starts
+contract, ephemeral-port publication), the anomaly-rule engine (latching,
+structured alert events, subscribers, every built-in rule), the
+guard/serving escalation wiring, and the `scripts/igg_top.py` cluster
+aggregation.  The real 2-process leg is the soak ``live_plane`` scenario
+(`scripts/soak.py --quick`).
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils import liveplane as lp
+from implicitglobalgrid_tpu.utils import telemetry as tele
+from implicitglobalgrid_tpu.utils import tracing
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_repo = os.path.dirname(_here)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    tele.reset()
+    tracing.reset()
+    lp.reset()
+    yield
+    lp.reset()
+    tele.reset()
+    tracing.reset()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.read()
+
+
+# -- server lifecycle ---------------------------------------------------------
+
+
+def test_server_absent_without_port(monkeypatch):
+    monkeypatch.delenv("IGG_METRICS_PORT", raising=False)
+    assert not lp.enabled()
+    assert lp.ensure_server() is None
+    assert lp.server_port() is None
+
+
+def test_server_never_starts_when_telemetry_disabled(monkeypatch):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    assert not lp.enabled()
+    assert lp.ensure_server() is None
+    # heartbeat_tick is equally inert: no engine work, no gauges
+    assert lp.heartbeat_tick() == []
+    assert tele.snapshot()["gauges"] == {}
+
+
+def test_ephemeral_port_published(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    server = lp.ensure_server()
+    assert server is not None and server.port > 0
+    assert lp.ensure_server() is server  # idempotent
+    # published: the gauge (rides the rank-0 heartbeat) + the endpoint file
+    assert tele.snapshot()["gauges"]["liveplane.port"] == server.port
+    doc = json.loads((tmp_path / lp.endpoint_filename(0)).read_text())
+    assert doc["port"] == server.port and doc["rank"] == 0
+    assert doc["host"] == "127.0.0.1"
+
+
+def test_metrics_endpoint_byte_identical_to_dump(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    tele.counter("lp.test_total").inc(3)
+    tele.gauge("lp.gauge").set(2.5)
+    h = tele.histogram("lp.hist")
+    for v in (1.0, 2.0, 3.0):
+        h.record(v)
+    port = lp.start_server().port
+    body = _get(port, "/metrics").decode()
+    _json_path, prom_path = tele.dump_metrics(str(tmp_path / "m"))
+    assert body == open(prom_path).read()
+    assert "igg_lp_test_total_total" in body
+
+
+def test_healthz_and_spans_endpoints(monkeypatch):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    tele.note_progress("diffusion3d", 7)
+    with tracing.trace_span("lp.done", step=1):
+        pass
+    port = lp.start_server().port
+    h = json.loads(_get(port, "/healthz"))
+    assert h["ok"] is True and h["rank"] == 0
+    assert h["uptime_s"] >= 0
+    assert h["last_step"]["kind"] == "diffusion3d"
+    assert h["last_step"]["step"] == 7 and h["last_step"]["age_s"] >= 0
+    assert h["guard"]["trips"] == 0
+    assert h["alerts"] == {"active": [], "recent": [], "fired_total": 0}
+    assert "skew" not in h and "serving" not in h  # absence is meaningful
+    s = json.loads(_get(port, "/spans"))
+    assert [x["name"] for x in s["spans"]] == ["lp.done"]
+    assert s["open"] == []
+
+
+def test_unknown_endpoint_404(monkeypatch):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    port = lp.start_server().port
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(port, "/nope")
+    assert e.value.code == 404
+
+
+# -- rolling SLO windows ------------------------------------------------------
+
+
+def test_publish_slo_gauges(monkeypatch):
+    h = tele.histogram("m.step_seconds")
+    for v in (0.1, 0.2, 0.3):
+        h.record(v)
+    other = tele.histogram("m.unrelated")
+    other.record(1.0)
+    out = lp.publish_slo_gauges()
+    assert set(out) == {"m.step_seconds"}
+    g = tele.snapshot()["gauges"]
+    assert g["slo.m.step_seconds.p50"] == pytest.approx(0.2)
+    assert g["slo.m.step_seconds.p99"] == pytest.approx(0.3)
+    assert not any(k.startswith("slo.m.unrelated") for k in g)
+
+
+def test_publish_slo_gauges_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    assert lp.publish_slo_gauges() == {}
+
+
+# -- rule engine --------------------------------------------------------------
+
+
+class _FlagRule(lp.Rule):
+    name = "flag"
+    severity = "critical"
+
+    def __init__(self):
+        self.on = False
+
+    def check(self, ctx):
+        return {"why": "flag"} if self.on else None
+
+
+def test_engine_latches_one_event_per_episode(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    rule = _FlagRule()
+    eng = lp.RuleEngine(rules=[rule])
+    assert eng.tick() == []
+    rule.on = True
+    fired = eng.tick()
+    assert len(fired) == 1
+    a = fired[0]
+    assert a["rule"] == "flag" and a["severity"] == "critical"
+    assert a["rank"] == 0 and a["evidence"] == {"why": "flag"}
+    assert eng.tick() == []  # latched: same episode fires once
+    assert [x["rule"] for x in eng.active_alerts()] == ["flag"]
+    rule.on = False
+    eng.tick()  # clears -> re-arms
+    assert eng.active_alerts() == []
+    rule.on = True
+    assert len(eng.tick()) == 1  # a NEW episode fires again
+    events = tele.read_events(tmp_path / "events.jsonl")
+    alerts = [e for e in events if e["type"] == "alert.flag"]
+    assert len(alerts) == 2
+    assert alerts[0]["severity"] == "critical"
+    assert alerts[0]["evidence"] == {"why": "flag"}
+    assert alerts[0]["rank"] == 0
+    assert tele.snapshot()["counters"]["alerts.fired_total"] == 2
+
+
+def test_engine_subscribers_and_cursor():
+    rule = _FlagRule()
+    eng = lp.RuleEngine(rules=[rule])
+    seen = []
+    eng.subscribe(seen.append)
+    rule.on = True
+    eng.tick()
+    assert len(seen) == 1 and seen[0]["rule"] == "flag"
+    seq, fresh = eng.alerts_since(0)
+    assert len(fresh) == 1 and seq == 1
+    seq2, fresh2 = eng.alerts_since(seq)
+    assert fresh2 == [] and seq2 == seq
+    eng.unsubscribe(seen.append)
+    rule.on = False
+    eng.tick()
+    rule.on = True
+    eng.tick()
+    assert len(seen) == 1  # unsubscribed: second episode not delivered
+
+
+def test_broken_rule_never_breaks_the_tick():
+    class Broken(lp.Rule):
+        name = "broken"
+
+        def check(self, ctx):
+            raise RuntimeError("boom")
+
+    rule = _FlagRule()
+    rule.on = True
+    eng = lp.RuleEngine(rules=[Broken(), rule])
+    assert [a["rule"] for a in eng.tick()] == ["flag"]
+
+
+def _ctx(histograms=None, gauges=None, progress=None, rss=None,
+         source="heartbeat", rank=0):
+    return {
+        "now": 0.0,
+        "source": source,
+        "model": None,
+        "snapshot": {
+            "rank": rank,
+            "histograms": histograms or {},
+            "gauges": gauges or {},
+            "counters": {},
+        },
+        "progress": progress,
+        "rss": rss,
+    }
+
+
+def test_teff_drop_rule_self_prior_and_reconcile_prior():
+    rule = lp.TeffDropRule(0.5)
+    hist = {
+        "diffusion3d.t_eff_gbs": {
+            "count": 50,
+            "p90": 100.0,
+            "window": {"count": 10, "p50": 30.0},
+        }
+    }
+    # window p50 30 vs self-prior p90 100: 30 < 50 -> fires, source lifetime
+    ev = rule.check(_ctx(histograms=hist))
+    assert ev and ev["expectation_source"] == "lifetime_p90"
+    assert ev["expected_gbs"] == 100.0
+    # an explicit reconcile-derived expectation wins over the self-prior
+    lp.set_teff_expectation("diffusion3d", 40.0)
+    ev = rule.check(_ctx(histograms=hist))
+    assert ev is None  # 30 >= 0.5 * 40
+    lp.set_teff_expectation("diffusion3d", 200.0)
+    ev = rule.check(_ctx(histograms=hist))
+    assert ev and ev["expectation_source"] == "reconcile"
+    # warm-up guards: too few window or lifetime samples -> quiet
+    hist["diffusion3d.t_eff_gbs"]["window"]["count"] = 2
+    assert rule.check(_ctx(histograms=hist)) is None
+
+
+def test_skew_sustained_rule_fires_on_slowest_rank_only():
+    rule = lp.SkewSustainedRule(k=2)
+    gauges = {"skew.step_seconds_max_over_min": 5.0, "skew.slowest_rank": 0}
+    assert rule.check(_ctx(gauges=gauges)) is None  # streak 1 of 2
+    # scrape ticks must not advance the streak (gauges move at heartbeats)
+    assert rule.check(_ctx(gauges=gauges, source="scrape")) is None
+    ev = rule.check(_ctx(gauges=gauges))  # streak 2 -> fires
+    assert ev and ev["ratio"] == 5.0 and ev["windows"] == 2
+    # this rank is NOT the slowest: resets, never fires here
+    gauges["skew.slowest_rank"] = 1
+    assert rule.check(_ctx(gauges=gauges)) is None
+    assert rule.check(_ctx(gauges=gauges)) is None
+
+
+def test_convergence_stall_rule():
+    rule = lp.ConvergenceStallRule(k=2, gauge="serving.pt_residual_min")
+    g = {"serving.pt_residual_min": 1.0}
+    assert rule.check(_ctx(gauges=g)) is None  # first observation = best
+    g["serving.pt_residual_min"] = 0.5  # improving: resets
+    assert rule.check(_ctx(gauges=g)) is None
+    assert rule.check(_ctx(gauges=g)) is None  # stagnant x1
+    ev = rule.check(_ctx(gauges=g))  # stagnant x2 -> fires
+    assert ev and ev["residual"] == 0.5 and ev["windows"] == 2
+    g.clear()  # gauge gone (no tol members): resets quietly
+    assert rule.check(_ctx(gauges=g)) is None
+
+
+def test_convergence_stall_rule_population_changes():
+    rule = lp.ConvergenceStallRule(k=2, gauge="serving.pt_residual_min")
+    # a frozen residual with ZERO watched members is a retired member's
+    # leftover, not a stall — the population gauge disarms the rule
+    g = {"serving.pt_residual_min": 0.5, "serving.pt_residual_watched": 0}
+    for _ in range(4):
+        assert rule.check(_ctx(gauges=g)) is None
+    # watched again: stagnation counts from a fresh episode
+    g["serving.pt_residual_watched"] = 1
+    assert rule.check(_ctx(gauges=g)) is None  # best = 0.5
+    assert rule.check(_ctx(gauges=g)) is None  # stagnant x1
+    assert rule.check(_ctx(gauges=g))  # stagnant x2 -> fires
+    # a fresh member admitted at a much HIGHER residual resets the
+    # episode (population change), it does not count as stagnation
+    g["serving.pt_residual_min"] = 5.0
+    assert rule.check(_ctx(gauges=g)) is None
+    assert rule.check(_ctx(gauges=g)) is None  # stagnant x1 vs new best
+    assert rule.check(_ctx(gauges=g))  # stagnant x2 -> fires again
+
+
+def test_step_stall_rule_deadline_and_gates(monkeypatch):
+    rule = lp.StepStallRule(floor_s=1.0, factor=20.0)
+    prog = {"kind": "m", "step": 3, "age_s": 5.0, "init": False,
+            "done": False}
+    ev = rule.check(_ctx(progress=dict(prog), source="scrape"))
+    assert ev and ev["age_s"] == 5.0 and ev["deadline_s"] == 1.0
+    # the window p50 stretches the deadline (20 * 0.5 = 10 > age 5)
+    hist = {"m.step_seconds": {"p50": 0.5, "count": 9,
+                               "window": {"p50": 0.5, "count": 9}}}
+    assert rule.check(_ctx(histograms=hist, progress=dict(prog))) is None
+    # IGG_WATCHDOG_S pins the deadline outright
+    monkeypatch.setenv("IGG_WATCHDOG_S", "2")
+    ev = rule.check(_ctx(histograms=hist, progress=dict(prog)))
+    assert ev and ev["deadline_s"] == 2.0
+    monkeypatch.delenv("IGG_WATCHDOG_S")
+    # bring-up and completed runs are not stalls
+    assert rule.check(_ctx(progress={**prog, "init": True})) is None
+    assert rule.check(_ctx(progress={**prog, "done": True})) is None
+    assert rule.check(_ctx(progress=None)) is None
+
+
+def test_rss_growth_rule():
+    rule = lp.RssGrowthRule(factor=1.5, min_bytes=1000)
+    base = 100_000
+    assert rule.check(_ctx(rss=base)) is None  # first heartbeat = baseline
+    assert rule.check(_ctx(rss=base + 500)) is None  # within bounds
+    ev = rule.check(_ctx(rss=base * 2))
+    assert ev and ev["baseline_bytes"] == base and ev["growth"] == 2.0
+    # absolute floor: 1.5x growth of a tiny baseline stays quiet
+    small = lp.RssGrowthRule(factor=1.5, min_bytes=10**9)
+    assert small.check(_ctx(rss=base)) is None
+    assert small.check(_ctx(rss=base * 10)) is None
+
+
+# -- escalation wiring --------------------------------------------------------
+
+
+def test_critical_alert_forces_guard_probe(monkeypatch, tmp_path):
+    from implicitglobalgrid_tpu.utils.resilience import GuardError, RunGuard
+
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    import jax.numpy as jnp
+
+    Tg = igg.ones((8, 8, 8), "float64").at[2, 2, 2].set(jnp.nan)
+    # guard_every=0: the cadence alone would NEVER probe this state
+    guard = RunGuard(guard_every=0, policy="raise", names=("T",))
+    state, _ = guard.start((Tg,))
+    state, it = guard.on_step((Tg,), 1)  # no alert: passes through
+    assert it == 1
+    guard.on_alert({"severity": "warn", "rule": "x"})  # warn: no probe
+    state, it = guard.on_step((Tg,), 2)
+    assert it == 2
+    guard.on_alert({"severity": "critical", "rule": "step_stall"})
+    with pytest.raises(GuardError):
+        guard.on_step((Tg,), 3)
+    events = tele.read_events(tmp_path / "events.jsonl")
+    probe = [e for e in events if e["type"] == "guard.alert_probe"]
+    assert len(probe) == 1 and probe[0]["rule"] == "step_stall"
+    snap = tele.snapshot()
+    assert snap["counters"]["resilience.alert_probes"] == 1
+    assert snap["counters"]["resilience.guard_trips"] == 1
+
+
+def test_guarded_time_loop_subscribes_for_loop_lifetime(monkeypatch):
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.utils.resilience import RunGuard, \
+        guarded_time_loop
+
+    state, params = diffusion3d.setup(8, 8, 8, quiet=True)
+    eng = lp.get_engine()
+    seen_during = []
+
+    class Probe(lp.Rule):
+        name = "probe"
+        severity = "warn"
+
+        def check(self, ctx):
+            seen_during.append(len(eng._subscribers))
+            return None
+
+    eng.register(Probe())
+    monkeypatch.setenv("IGG_HEARTBEAT_EVERY", "1")
+    guard = RunGuard(guard_every=1, names=("T", "Cp"))
+    guarded_time_loop(
+        diffusion3d.make_step(params), state, 2, guard=guard,
+        sync_every_step=True, model="diffusion3d",
+    )
+    # the guard's on_alert was subscribed while the loop ran...
+    assert seen_during and all(n == 1 for n in seen_during)
+    # ...and unsubscribed afterwards
+    assert eng._subscribers == []
+
+
+def test_serving_escalation_evicts_on_single_process(monkeypatch, tmp_path):
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    _, params = diffusion3d.setup(8, 8, 8, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=1, guard_policy="off")
+
+    state, _ = diffusion3d.setup(8, 8, 8, init_grid=False)
+    bad_T = np.asarray(state[0]).copy()
+    bad_T[(1,) * bad_T.ndim] = np.nan
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gg = igg.get_global_grid()
+    badt = jax.device_put(
+        bad_T, NamedSharding(gg.mesh, P(*igg.AXIS_NAMES[: bad_T.ndim]))
+    )
+    m = loop.submit(Request(state=(badt,) + tuple(state[1:]), max_steps=99))
+    loop.run_round()
+    assert loop.active_members == 1  # guard off: the NaN member survives
+    loop._escalate({"rule": "step_stall", "severity": "critical",
+                    "evidence": {}})
+    assert loop.active_members == 0
+    assert loop.results[m].status == "evicted"
+    events = tele.read_events(tmp_path / "events.jsonl")
+    esc = [e for e in events if e["type"] == "serving.alert_escalation"]
+    assert len(esc) == 1 and esc[0]["rule"] == "step_stall"
+    assert tele.snapshot()["counters"]["serving.alert_escalations"] == 1
+
+
+def test_serving_round_records_latency_and_residual_gauge():
+    from implicitglobalgrid_tpu.models import porous_convection3d as porous
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    s, params = porous.setup(8, 8, 8, init_grid=False, npt=3)
+    loop = ServingLoop(porous, params, capacity=1, steps_per_round=1)
+    loop.submit(Request(state=s, max_steps=2, tol=1e-30, tenant="t"))
+    loop.run(max_rounds=3)
+    snap = tele.snapshot()
+    assert snap["histograms"]["serving.round_seconds"]["count"] >= 2
+    assert "window" in snap["histograms"]["serving.round_seconds"]
+    assert snap["gauges"]["serving.pt_residual_min"] > 0
+    # pool drained: the population gauge disarms the convergence rule
+    assert snap["gauges"]["serving.pt_residual_watched"] == 0
+
+
+# -- healthz with live context ------------------------------------------------
+
+
+def test_healthz_reflects_alerts_and_slo(monkeypatch):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    rule = _FlagRule()
+    rule.severity = "critical"
+    eng = lp.get_engine()
+    eng.rules[:] = [rule]
+    h = tele.histogram("m.step_seconds")
+    for v in (0.1, 0.2):
+        h.record(v)
+    rule.on = True
+    port = lp.start_server().port
+    doc = json.loads(_get(port, "/healthz"))
+    # the scrape itself ran the engine tick (scrape-time evaluation)
+    assert doc["ok"] is False
+    assert [a["rule"] for a in doc["alerts"]["active"]] == ["flag"]
+    assert doc["alerts"]["fired_total"] == 1
+    assert doc["slo"]["m.step_seconds"]["count"] == 2
+
+
+# -- igg_top cluster aggregation ----------------------------------------------
+
+
+def _igg_top():
+    scripts = os.path.join(_repo, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import igg_top
+
+    return igg_top
+
+
+def test_igg_top_merges_expositions_with_rank_labels():
+    igg_top = _igg_top()
+    per_rank = {
+        0: "# TYPE igg_m_steps_total counter\nigg_m_steps_total 4\n"
+           '# TYPE igg_m_step_seconds summary\n'
+           'igg_m_step_seconds{quantile="0.5"} 0.1\n',
+        1: "# TYPE igg_m_steps_total counter\nigg_m_steps_total 7\n",
+    }
+    merged = igg_top.merge_expositions(per_rank)
+    lines = merged.splitlines()
+    assert 'igg_m_steps_total{rank="0"} 4' in lines
+    assert 'igg_m_steps_total{rank="1"} 7' in lines
+    # existing labels are preserved behind the rank label
+    assert 'igg_m_step_seconds{rank="0",quantile="0.5"} 0.1' in lines
+    # one TYPE header per metric, before its first sample
+    assert lines.count("# TYPE igg_m_steps_total counter") == 1
+    assert lines.index("# TYPE igg_m_steps_total counter") < lines.index(
+        'igg_m_steps_total{rank="0"} 4'
+    )
+
+
+def test_igg_top_summary_rows_and_table():
+    igg_top = _igg_top()
+    healths = {
+        1: {
+            "ok": False,
+            "coords": [1, 0, 0],
+            "last_step": {"step": 40, "age_s": 9.3},
+            "slo": {"diffusion3d.step_seconds": {"p50": 0.01, "p99": 0.02}},
+            "skew": {"step_seconds_max_over_min": 3.2},
+            "alerts": {"active": [
+                {"rule": "step_stall", "severity": "critical"}
+            ]},
+        },
+        0: {
+            "ok": True,
+            "coords": [0, 0, 0],
+            "last_step": {"step": 42, "age_s": 0.1},
+            "slo": {
+                "diffusion3d.step_seconds": {"p50": 0.01, "p99": 0.015},
+                "diffusion3d.t_eff_gbs": {"p50": 123.0},
+            },
+            "alerts": {"active": []},
+        },
+    }
+    rows = igg_top.summary_rows(healths)
+    assert [r["rank"] for r in rows] == [0, 1]  # sorted by rank
+    assert rows[0]["teff_gbs"] == 123.0 and rows[0]["alerts"] == "-"
+    assert rows[1]["alerts"] == "step_stall(critical)"
+    assert rows[1]["skew"] == 3.2
+    table = igg_top.render_table(rows)
+    assert "step_stall(critical)" in table and "ALRT" in table
+    assert len(table.splitlines()) == 4  # header + rule + 2 ranks
+
+
+def test_igg_top_scrapes_a_real_server(monkeypatch, tmp_path):
+    igg_top = _igg_top()
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tele.counter("m.steps").inc(5)
+    port = lp.start_server().port
+    res = igg_top.scrape(f"127.0.0.1:{port}")
+    assert res["health"]["rank"] == 0
+    assert "igg_m_steps_total" in res["metrics"]
+    # --dir discovery reads the endpoint file the server published
+    eps = igg_top.discover_endpoints(
+        type("A", (), {"endpoints": [], "endpoints_file": None,
+                       "dir": str(tmp_path)})()
+    )
+    assert eps == [f"127.0.0.1:{port}"]

@@ -681,3 +681,143 @@ def test_heartbeat_attaches_skew_and_serving_context(monkeypatch, tmp_path):
         "slowest_rank": 1.0,
     }
     assert hb["serving"] == {"active_members": 2.0, "queue_depth": 4.0}
+
+
+# -- Rolling SLO windows (ISSUE 11) -------------------------------------------
+
+
+def test_histogram_rolling_windows(monkeypatch):
+    monkeypatch.setenv("IGG_SLO_WINDOW_S", "10")
+    h = tele.histogram("w.hist")
+    # window 1: [t=0, 10)
+    for v in (1.0, 2.0, 3.0):
+        h.record(v, now=0.0)
+    w = h.window_summary(now=5.0)
+    assert w["count"] == 3 and w["window_s"] == 10.0 and w["windows"] == 1
+    assert w["p50"] == 2.0
+    # window 2 opens at t=12: the old window slides into the ring
+    h.record(100.0, now=12.0)
+    w = h.window_summary(now=12.0)
+    assert w["count"] == 4 and w["windows"] == 2
+    assert w["p99"] == 100.0
+    # beyond the horizon (SLO_WINDOWS * 10s) old windows fall out...
+    w = h.window_summary(now=12.1 + tele.SLO_WINDOWS * 10)
+    assert w is None
+    # ...while the LIFETIME reservoir keeps everything
+    s = h.summary()
+    assert s["count"] == 4 and s["max"] == 100.0
+
+
+def test_window_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("IGG_SLO_WINDOW_S", "1")
+    h = tele.histogram("w.ring")
+    for i in range(20):  # 20 windows, ring keeps SLO_WINDOWS
+        h.record(float(i), now=float(i))
+    assert len(h._win_ring) == tele.SLO_WINDOWS - 1
+    w = h.window_summary(now=19.0)
+    # the live view spans only the last SLO_WINDOWS windows' samples
+    assert w["count"] == tele.SLO_WINDOWS
+    assert w["p50"] == float(19 - tele.SLO_WINDOWS // 2)
+
+
+def test_windows_absent_until_first_record_and_when_disabled(monkeypatch):
+    h = tele.histogram("w.lazy")
+    assert h._win_cur is None and h._win_ring is None  # lazy allocation
+    assert h.window_summary() is None
+    assert "window" not in h.summary()
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    # the disabled-mode singleton allocates nothing — no windows anywhere
+    noop = tele.histogram("w.never")
+    assert noop is tele.NOOP
+    noop.record(1.0, now=0.0)
+    assert "w.never" not in tele.snapshot()["histograms"]
+
+
+def test_concurrent_scrape_hammer():
+    """ISSUE 11 satellite: a reader thread snapshots/renders the exposition
+    in a tight loop while the main thread records — the exact
+    /metrics-during-step-loop interleaving.  Any exception on either side
+    (or a torn histogram summary) fails the pin."""
+    import threading
+
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = tele.snapshot()
+                text = tele.prometheus_text(snap)
+                for name, s in snap["histograms"].items():
+                    # invariants a torn read would break
+                    assert s["count"] >= 0
+                    if s["count"]:
+                        assert s["min"] <= s["max"]
+                assert text.endswith("\n")
+        except Exception as e:  # pragma: no cover - the failure path
+            errors.append(e)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    h = tele.histogram("hammer.hist")
+    c = tele.counter("hammer.count")
+    g = tele.gauge("hammer.gauge")
+    for i in range(3000):
+        h.record(float(i % 97))
+        c.inc()
+        g.set(float(i))
+        if i % 500 == 0:
+            tele.histogram(f"hammer.h{i}").record(1.0)  # registry growth
+    stop.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert errors == []
+    snap = tele.snapshot()
+    assert snap["counters"]["hammer.count"] == 3000
+    assert snap["histograms"]["hammer.hist"]["count"] == 3000
+
+
+# -- proc RSS gauge (ISSUE 11 satellite) --------------------------------------
+
+
+def test_proc_rss_bytes_reads_something():
+    rss = tele.proc_rss_bytes()
+    # Linux CI: /proc/self/statm must resolve; a python process with jax
+    # loaded sits far above 10 MB
+    assert rss is not None and rss > 10 * 1024 * 1024
+
+
+def test_heartbeat_publishes_rss_gauge(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_HEARTBEAT_EVERY", "1")
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    loop = tele.step_loop("m", bytes_per_step=8, total_steps=1)
+    loop.on_step(1)
+    assert tele.snapshot()["gauges"]["proc.rss_bytes"] > 0
+
+
+# -- progress record (the live plane's last-step-age source) ------------------
+
+
+def test_note_progress_roundtrip():
+    assert tele.last_progress() is None
+    tele.note_progress("m", 0, init=True)
+    p = tele.last_progress()
+    assert p["init"] and not p["done"] and p["step"] == 0
+    tele.note_progress("m", 3)
+    p = tele.last_progress()
+    assert not p["init"] and p["step"] == 3 and p["age_s"] >= 0
+    tele.note_progress("m", 3, done=True)
+    assert tele.last_progress()["done"]
+    tele.reset()
+    assert tele.last_progress() is None
+
+
+def test_step_loop_progress_lifecycle(monkeypatch, tmp_path):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    loop = tele.step_loop("m", total_steps=2)
+    assert tele.last_progress()["init"]  # bring-up/compile phase marked
+    loop.on_step(1)
+    p = tele.last_progress()
+    assert p["step"] == 1 and not p["init"] and not p["done"]
+    loop.finish(2)
+    assert tele.last_progress()["done"]

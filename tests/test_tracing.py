@@ -369,6 +369,126 @@ def test_flight_recorder_appends_complete_lines(monkeypatch, tmp_path):
     assert bundles[-1]["info"] == {"detail": 2}
 
 
+# -- open-span tracking (ISSUE 11 satellite) ----------------------------------
+
+
+def test_open_spans_tracks_the_executing_stack():
+    assert tracing.open_spans() == []
+    with tracing.trace_span("outer", step=4):
+        with tracing.trace_span("inner"):
+            open_ = tracing.open_spans()
+            assert [s["name"] for s in open_] == ["outer", "inner"]
+            assert all(s["open"] is True for s in open_)
+            assert open_[0]["args"] == {"step": 4}
+            assert all(s["dur"] >= 0 for s in open_)
+        assert [s["name"] for s in tracing.open_spans()] == ["outer"]
+    # everything closed: stack empty, no per-thread entry leaked
+    assert tracing.open_spans() == []
+    assert tracing._open_stacks == {}
+
+
+def test_crash_inside_span_lands_in_flight_bundle(monkeypatch, tmp_path):
+    """The ISSUE 11 satellite pin: the span you most want at crash time is
+    the one CURRENTLY EXECUTING — the flight bundle must carry it with an
+    ``open: true`` marker alongside the closed ring."""
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    with tracing.trace_span("before.crash"):
+        pass
+    with pytest.raises(RuntimeError, match="boom"):
+        with tracing.trace_span("igg.step", model="m", step=9):
+            tracing.dump_flight_recorder("test.crash", step=9)
+            raise RuntimeError("boom")
+    bundles = tracing.read_flight_bundles(
+        tmp_path / tracing.flight_filename(0)
+    )
+    spans = bundles[-1]["spans"]
+    closed = [s for s in spans if not s.get("open")]
+    open_ = [s for s in spans if s.get("open")]
+    assert [s["name"] for s in closed] == ["before.crash"]
+    assert [s["name"] for s in open_] == ["igg.step"]
+    assert open_[0]["args"] == {"model": "m", "step": 9}
+    assert open_[0]["dur"] >= 0
+
+
+def test_open_spans_disabled_mode_untouched(monkeypatch):
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    with tracing.trace_span("x"):
+        assert tracing.open_spans() == []  # NOOP_SPAN touches no stack
+
+
+# -- span_stats + the summarize subcommand (ISSUE 11 satellite) ---------------
+
+
+def test_span_stats_aggregates_across_ranks():
+    lists = [
+        [
+            {"name": "igg.step", "t0": 0.0, "dur": 0.001},
+            {"name": "igg.step", "t0": 1.0, "dur": 0.003},
+            {"name": "igg.gather", "t0": 2.0, "dur": 0.010},
+            {"name": "stuck", "t0": 3.0, "dur": 99.0, "open": True},
+        ],
+        [{"name": "igg.step", "t0": 0.0, "dur": 0.002}],
+    ]
+    stats = tracing.span_stats(lists)
+    assert list(stats) == ["igg.gather", "igg.step"]  # sorted
+    st = stats["igg.step"]
+    assert st["count"] == 3
+    assert st["total_s"] == pytest.approx(0.006)
+    assert st["p50_s"] == pytest.approx(0.002)
+    assert st["p99_s"] == pytest.approx(0.003)
+    assert st["max_s"] == pytest.approx(0.003)
+    assert "stuck" not in stats  # open spans carry ages, not durations
+
+
+#: the golden summarize table for `_summarize_fixture` — change the CLI
+#: format deliberately and update this pin with it
+_SUMMARIZE_GOLDEN = """\
+# 4 span(s) across rank(s) [0, 1]
+span                               count   total_ms   mean_ms    p50_ms    p99_ms    max_ms
+-------------------------------------------------------------------------------------------
+igg.gather                             1     10.000    10.000    10.000    10.000    10.000
+igg.step                               3      6.000     2.000     2.000     3.000     3.000"""
+
+
+def _summarize_fixture(tmp_path):
+    _synthetic_rank_file(
+        tmp_path, 0, perf0=0.0, wall=100.0,
+        spans=[
+            {"name": "igg.step", "t0": 0.0, "dur": 0.001},
+            {"name": "igg.step", "t0": 1.0, "dur": 0.003},
+            {"name": "igg.gather", "t0": 2.0, "dur": 0.010},
+        ],
+    )
+    _synthetic_rank_file(
+        tmp_path, 1, perf0=0.0, wall=100.0,
+        spans=[{"name": "igg.step", "t0": 0.0, "dur": 0.002}],
+    )
+
+
+def test_igg_trace_cli_summarize_golden(tmp_path):
+    _summarize_fixture(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_repo, env.get("PYTHONPATH")) if p
+    )
+    script = os.path.join(_repo, "scripts", "igg_trace.py")
+    r = subprocess.run(
+        [sys.executable, script, "summarize", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.rstrip("\n") == _SUMMARIZE_GOLDEN
+    # --json mode: machine-readable, equals the library aggregation
+    r = subprocess.run(
+        [sys.executable, script, "summarize", "--json", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr
+    stats = json.loads(r.stdout)
+    assert stats["igg.step"]["count"] == 3
+    assert stats["igg.gather"]["total_s"] == pytest.approx(0.010)
+
+
 # -- cost-model reconciliation ------------------------------------------------
 
 
