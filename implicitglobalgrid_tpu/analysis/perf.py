@@ -106,7 +106,14 @@ def load_bench_records(repo_root: str) -> tuple[list, list]:
 #: ``teff_grad`` are GB/s; ``members_per_s`` is the batched-serving
 #: members/s/chip record (``bench.py batch``, ISSUE 8) — same one-sided
 #: drop semantics, so a batching regression fails like a bandwidth one.
-GATED_KEYS = ("teff", "teff_grad", "members_per_s")
+#: ``rounds_per_s`` plus the INVERSE submit→result latencies
+#: ``result_p50_per_s``/``result_p99_per_s`` are the front-door serving
+#: record (``extras.frontdoor_serving``, ISSUE 12): inverting the latency
+#: makes "p99 got slower" a one-sided DROP, so the existing gate catches
+#: it without new comparison semantics (the raw seconds ride along as
+#: `REPORTED_KEYS`).
+GATED_KEYS = ("teff", "teff_grad", "members_per_s", "rounds_per_s",
+              "result_p50_per_s", "result_p99_per_s")
 
 
 def gate_metrics(record: dict) -> dict:
@@ -136,8 +143,10 @@ def gate_metrics(record: dict) -> dict:
 #: ``achieved_fraction`` is the cost-model reconciliation number
 #: (`analysis.reconcile` — ``extras.efficiency``), carried per round so a
 #: future gate has a trajectory to regress against before it starts
-#: failing PRs on it.
-REPORTED_KEYS = ("achieved_fraction",)
+#: failing PRs on it; the ``submit_to_result_*`` seconds are the raw
+#: front-door latencies whose inverses are gated (human-readable twins).
+REPORTED_KEYS = ("achieved_fraction", "submit_to_result_p50_s",
+                 "submit_to_result_p99_s")
 
 
 def reported_metrics(record: dict) -> dict:

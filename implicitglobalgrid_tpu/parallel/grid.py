@@ -355,6 +355,7 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     from ..ops import gather as _gather
     from ..ops import halo as _halo
     from ..ops import stencil as _stencil
+    from ..serving import frontdoor as _frontdoor
     from ..utils import resilience as _resilience
     from ..utils import tracing as _tracing
 
@@ -364,6 +365,7 @@ def finalize_global_grid(*, finalize_distributed: bool = True) -> None:
     _resilience._clear_caches()
     _batched_mod._clear_caches()
     _tracing._clear_caches()
+    _frontdoor._clear_caches()
     _barrier_fn = None
     set_global_grid(None)
     if finalize_distributed:

@@ -1,4 +1,4 @@
-"""Batched multi-simulation serving (ISSUE 8; ROADMAP item 1).
+"""Batched multi-simulation serving (ISSUE 8 + 12; ROADMAP item 3).
 
 The steady-state loop for the million-users workload: a fixed-capacity slot
 pool holds B independent simulations batched along a leading ensemble axis
@@ -9,12 +9,36 @@ step budgets, per-member convergence masks (the porous PT residual), and
 per-member guard handling (a NaN in member k evicts or rolls back member
 k, never the batch).
 
+Since ISSUE 12 the pool speaks to the outside world: `FrontDoor` is the
+HTTP entry (``POST /v1/submit`` → `AdmissionController` — per-tenant
+token-bucket quotas, queue/SLO backpressure, cheap 429s with a
+cadence-derived ``Retry-After``), and `Autoscaler` grows/shrinks the
+topology under load through checkpoint + supervised restart + elastic
+resume (docs/serving.md).
+
 Public surface: `Request`, `MemberResult`, `ServingLoop` (see
-`serving.loop`); telemetry names and the event schema are documented in
-docs/observability.md, the knobs (``IGG_BATCH``,
-``IGG_BATCH_ROUND_STEPS``) in docs/usage.md.
+`serving.loop`); `FrontDoor` (`serving.frontdoor`); `AdmissionController`,
+`AdmissionPolicy` (`serving.admission`); `Autoscaler`, `AutoscalePolicy`,
+`Rung` (`serving.autoscale`).  Telemetry names and the event schema are
+documented in docs/observability.md, the knobs (``IGG_BATCH``,
+``IGG_BATCH_ROUND_STEPS``, ``IGG_SERVE_PORT``, ``IGG_TENANT_QUOTA``, ...)
+in docs/usage.md.
 """
 
+from .admission import AdmissionController, AdmissionPolicy
+from .autoscale import AutoscalePolicy, Autoscaler, Rung
+from .frontdoor import RESIZE_STATUS, FrontDoor
 from .loop import MemberResult, Request, ServingLoop
 
-__all__ = ["Request", "MemberResult", "ServingLoop"]
+__all__ = [
+    "Request",
+    "MemberResult",
+    "ServingLoop",
+    "FrontDoor",
+    "RESIZE_STATUS",
+    "AdmissionController",
+    "AdmissionPolicy",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "Rung",
+]

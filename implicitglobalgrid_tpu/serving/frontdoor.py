@@ -1,0 +1,928 @@
+"""igg.serving.frontdoor — the network-facing serving plane (ISSUE 12).
+
+PR 8 built the engine (`serving.ServingLoop`), PR 10 the live SLO surface
+it was designed to key on; this module is the door: requests arrive over
+HTTP, admission is gated on live queue/SLO state, and the topology
+grows/shrinks under load (ROADMAP item 3 — "make millions of users
+literal").  docs/serving.md is the operator guide.
+
+**HTTP surface** (stdlib ``http.server`` daemon thread, the
+`utils.liveplane` pattern; ``IGG_SERVE_PORT``, 0 = ephemeral, bind
+address ``IGG_SERVE_HOST``; rank 0 only — the front door is the cluster's
+single network entry, the per-rank liveplane endpoints stay the
+observability surface):
+
+- ``POST /v1/submit`` — ``{"tenant", "model", "size", "params":
+  {"ic_scale", "max_steps", "tol"}}`` → 202 ``{"request_id"}``.  Requests
+  carry *parameters*, never arrays: every rank rebuilds the member's
+  initial state locally from the spec (the model ``setup`` is a pure
+  function of the implicit global grid), which is what lets one rank's
+  network traffic drive an SPMD pool.  Invalid → 400; admission-rejected
+  → a cheap 429 with a ``Retry-After`` derived from the current round
+  cadence (`admission.retry_after_s`) and a machine-readable ``reason``
+  (``quota`` | ``backpressure`` | ``slo``).
+- ``GET /v1/result/<id>`` — ``pending`` | ``accepted`` | ``done`` (final
+  status, step count, residual, and a per-field sha256 digest of the
+  de-duplicated global state — computed collectively at retirement, so a
+  client can verify bit-identity without shipping fields over HTTP).
+- ``GET /v1/status`` — occupancy, admission/autoscaler state, request
+  ledger counts.  ``GET /metrics`` / ``GET /healthz`` mirror the
+  liveplane endpoints so one scrape of the front-door port sees the
+  ``frontdoor.*`` ledger mid-run.
+- ``POST /v1/shutdown`` — broadcast a clean stop (operator/supervisor
+  surface).
+
+**Control plane.**  `ServingLoop` state is SPMD: every rank must submit
+the same members in the same order, yet only rank 0 hears the network.
+`serve_rounds` therefore runs one control SYNC per iteration: rank 0
+drains its pending specs (plus drain/resize/shutdown directives) into one
+JSON message and broadcasts it — a two-phase host-side collective (scalar
+length via `utils.tracing.all_ranks_value`, then a padded byte buffer
+over the same scatter/pmax transport `skew_probe` rides) — and every rank
+applies it identically.  Rank-local alerts still never drive collectives:
+admission rejections are rank-0-local, and every cross-rank mutation
+(admit, drain, resize, shutdown) travels through the broadcast.
+
+**Elastic autoscaling.**  The `autoscale.Autoscaler` verdict (rank 0, at
+heartbeat cadence, over the same gauge view admission uses) becomes a
+``resize`` directive: every rank checkpoints the batched pool
+(`utils.checkpoint.save_checkpoint` — slot metadata and the front-door
+request ledger ride ``extra``), rank 0 atomically publishes
+``resize.json``, and `serve_rounds` returns ``"resize"`` so the process
+can exit with `RESIZE_STATUS` for its supervisor to relaunch at the
+target topology — the supervised-restart mechanism the soak
+``elastic_failover`` drill proves, pointed at growth.  On relaunch
+`elastic_resume` validates the topology change
+(`parallel.grid.elastic_topology_error`), reshards the pool through the
+checkpoint's elastic path (leading ensemble axis included), re-`adopt`\\ s
+every live member with its step count and budget intact, and rebuilds
+still-queued members from their specs — zero members dropped across a
+resize.  Scale-downs drain first: ``drain_above`` stops admissions into
+retiring slots, in-flight rounds finish, then the reshard runs.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import http.server
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..utils import config as _config
+from ..utils import liveplane as _liveplane
+from ..utils import telemetry as _telemetry
+from ..utils import tracing as _tracing
+from . import admission as _admission
+from .loop import Request, ServingLoop
+
+__all__ = [
+    "FrontDoor",
+    "RESIZE_STATUS",
+    "RESIZE_PLAN",
+    "endpoint_filename",
+    "state_digest",
+]
+
+#: exit status a serving process uses after writing a resize plan — the
+#: supervisor's signal to relaunch at the plan's topology (distinct from
+#: the fault injector's CRASH_STATUS 17)
+RESIZE_STATUS = 19
+
+#: the resize plan file rank 0 publishes into the checkpoint directory
+RESIZE_PLAN = "resize.json"
+
+#: padding quantum of the control broadcast (bounds the compile cache)
+_BCAST_PAD = 1024
+
+_bcast_cache: dict = {}
+
+
+def _clear_caches() -> None:
+    """Drop the compiled broadcast fns (wired into `finalize_global_grid`
+    like every sibling compiled-fn cache — entries close over the mesh)."""
+    _bcast_cache.clear()
+
+
+def endpoint_filename(rank: int) -> str:
+    return f"frontdoor.p{rank}.json"
+
+
+def state_digest(state) -> dict | None:
+    """Per-field sha256 of the de-duplicated GLOBAL state.
+
+    COLLECTIVE (rides `ops.gather.gather(dedup=True)`): every rank must
+    call it together; returns the digest dict on rank 0 and None
+    elsewhere.  Two runs produce identical digests iff their global fields
+    are bit-identical — the cross-topology acceptance check of the soak
+    ``frontdoor`` drill.
+    """
+    from ..ops import gather as _gather
+
+    hashes = []
+    on_root = True
+    for A in state:
+        dd = _gather.gather(A, dedup=True, root=0)
+        if dd is None:
+            on_root = False
+            continue
+        h = hashlib.sha256()
+        h.update(str((tuple(dd.shape), str(dd.dtype))).encode())
+        h.update(np.ascontiguousarray(dd).tobytes())
+        hashes.append(h.hexdigest())
+    if not on_root:
+        return None
+    return {"algo": "sha256", "fields": hashes}
+
+
+# -- control-plane broadcast --------------------------------------------------
+
+
+def _bcast_fn(gg, n: int):
+    """Compiled rank-0→all byte broadcast: every block contributes a
+    ``(1,1,1,n)`` f32 slab (rank 0's carry the payload, everyone else
+    zeros) and an all-axes ``pmax`` replicates the payload — the same
+    host-dispatched scatter/reduce transport shape as
+    `tracing.skew_probe`, proven on every supported backend."""
+    key = (gg.epoch, n)
+    fn = _bcast_cache.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.topology import AXIS_NAMES
+    from ..utils.compat import shard_map
+
+    def per_block(x):
+        return lax.pmax(x, AXIS_NAMES)
+
+    mapped = shard_map(
+        per_block,
+        mesh=gg.mesh,
+        in_specs=P(*AXIS_NAMES, None),
+        out_specs=P(),
+        check_vma=False,
+    )
+    fn = jax.jit(mapped)
+    _bcast_cache[key] = fn
+    return fn
+
+
+def broadcast_control(doc: dict | None) -> dict:
+    """Share rank 0's control message with every rank (rank 0 passes the
+    message, everyone else None).  COLLECTIVE at a deterministic cadence:
+    `FrontDoor.serve_rounds` calls it exactly once per iteration on every
+    rank.  Single-process grids return the message directly.  Two phases:
+    a scalar length share (empty message = length 0 ends the exchange),
+    then a `_BCAST_PAD`-padded byte buffer — bytes ride f32 exactly."""
+    from ..parallel import grid as _grid
+
+    if _telemetry.process_count() == 1:
+        return doc or {}
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.topology import AXIS_NAMES
+
+    gg = _grid.global_grid()
+    is_root = jax.process_index() == 0
+    data = (
+        json.dumps(doc, default=str).encode() if (is_root and doc) else b""
+    )
+    vals = _tracing.all_ranks_value(float(len(data)))
+    length = int(np.max(vals))
+    if length == 0:
+        return {}
+    n = -(-length // _BCAST_PAD) * _BCAST_PAD
+    payload = np.zeros((1, 1, 1, n), np.float32)
+    if data:
+        payload[0, 0, 0, :length] = np.frombuffer(data, np.uint8)
+
+    def _block(index, payload=payload, root=is_root):
+        return payload if root else np.zeros_like(payload)
+
+    sharding = NamedSharding(gg.mesh, P(*AXIS_NAMES, None))
+    arr = jax.make_array_from_callback((*gg.dims, n), sharding, _block)
+    out = np.asarray(_bcast_fn(gg, n)(arr)).reshape(-1)[:length]
+    return json.loads(out.astype(np.uint8).tobytes().decode())
+
+
+# -- the HTTP layer -----------------------------------------------------------
+
+
+def _make_handler(fd: "FrontDoor"):
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        server_version = "igg-frontdoor/1"
+
+        def _reply(self, code: int, body: dict, headers: dict | None = None,
+                   raw: bytes | None = None, ctype: str = "application/json"):
+            data = raw if raw is not None else json.dumps(
+                body, default=str
+            ).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0]
+            try:
+                if path.startswith("/v1/result/"):
+                    rid = path[len("/v1/result/"):]
+                    doc = fd.result_view(rid)
+                    if doc is None:
+                        self._reply(404, {"error": f"unknown request {rid!r}"})
+                    else:
+                        self._reply(200, doc)
+                elif path == "/v1/status":
+                    self._reply(200, fd.status_view())
+                elif path == "/metrics":
+                    self._reply(
+                        200, {}, raw=_telemetry.prometheus_text().encode(),
+                        ctype="text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/healthz":
+                    snap = _telemetry.snapshot()
+                    _liveplane.get_engine().tick("scrape", snap=snap)
+                    self._reply(200, _liveplane.health_snapshot(snap))
+                else:
+                    self.send_error(404, "unknown endpoint")
+            except Exception as e:  # a scrape must never kill the server
+                self.send_error(500, repr(e))
+
+        def do_POST(self):  # noqa: N802
+            path = self.path.split("?", 1)[0]
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if path == "/v1/submit":
+                    try:
+                        doc = json.loads(body.decode() or "{}")
+                        if not isinstance(doc, dict):
+                            raise ValueError("body must be a JSON object")
+                    except (ValueError, UnicodeDecodeError) as e:
+                        self._reply(400, {"error": f"bad JSON body: {e}"})
+                        return
+                    self._reply(*fd.handle_submit(doc))
+                elif path == "/v1/shutdown":
+                    fd.request_shutdown()
+                    self._reply(200, {"ok": True})
+                else:
+                    self.send_error(404, "unknown endpoint")
+            except Exception as e:
+                self.send_error(500, repr(e))
+
+        def log_message(self, *args):  # requests must not spam stderr
+            pass
+
+    return _Handler
+
+
+# -- the front door -----------------------------------------------------------
+
+
+class FrontDoor:
+    """One network entry in front of one `ServingLoop` (module docstring).
+
+    ``loop`` — the pool; ``admission`` — an `admission.AdmissionController`
+    (default: env-policy for the pool's capacity); ``autoscaler`` — an
+    `autoscale.Autoscaler` (None = fixed capacity); ``checkpoint_dir`` —
+    where resizes checkpoint and `elastic_resume` restores (required for
+    autoscaling); ``setup_kwargs`` — extra model ``setup`` kwargs every
+    member spec shares (``npt`` for porous, dtype overrides...);
+    ``digest_results`` — compute the collective per-field digest at each
+    retirement; ``port``/``host`` — override ``IGG_SERVE_PORT`` /
+    ``IGG_SERVE_HOST``.
+    """
+
+    def __init__(self, loop: ServingLoop, *, admission=None, autoscaler=None,
+                 port: int | None = None, host: str | None = None,
+                 checkpoint_dir: str | None = None,
+                 setup_kwargs: dict | None = None,
+                 digest_results: bool = True):
+        self.loop = loop
+        self.model = loop.model
+        self.checkpoint_dir = checkpoint_dir or loop.checkpoint_dir
+        self.admission = (
+            admission if admission is not None
+            else _admission.AdmissionController(capacity=loop.capacity)
+        )
+        self.autoscaler = autoscaler
+        self.setup_kwargs = dict(setup_kwargs or {})
+        self.digest_results = digest_results
+        self._lock = threading.RLock()
+        self._pending: collections.deque = collections.deque()
+        self._requests: dict[str, dict] = {}
+        self._next_request = 0
+        self._seen_results: set[int] = set()
+        self._shutdown = False
+        self._refusing: str | None = None  # "resizing": reject all submits
+        self._drain_target: dict | None = None
+        self._as_round = -1
+        self._as_t = 0.0
+        self._httpd = None
+        self._thread = None
+        self.port: int | None = None
+        self.rank = _telemetry._proc_index()
+        if self.autoscaler is not None:
+            if not self.checkpoint_dir:
+                raise ValueError(
+                    "autoscaling needs a checkpoint_dir: a resize IS a "
+                    "checkpoint + supervised restart."
+                )
+            # the RunGuard subscription mechanism: anomaly alerts reach the
+            # autoscaler's status view through the rule engine
+            _liveplane.subscribe(self.autoscaler.on_alert)
+        if self.rank == 0:
+            self._start_server(port, host)
+
+    # - server lifecycle -
+
+    def _start_server(self, port: int | None, host: str | None) -> None:
+        if host is None:
+            host = _config.serve_host_env() or "127.0.0.1"
+        if port is None:
+            port = _config.serve_port_env() or 0
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, int(port)), _make_handler(self)
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name="igg-frontdoor",
+            daemon=True,
+        )
+        self._thread.start()
+        _telemetry.gauge("frontdoor.port").set(self.port)
+        _telemetry.event("frontdoor.start", host=host, port=self.port)
+        directory = _config.telemetry_dir_env()
+        if directory:
+            pub_host = socket.gethostname() if host in ("0.0.0.0", "::") else host
+            doc = {"rank": self.rank, "pid": os.getpid(), "host": pub_host,
+                   "port": self.port, "ts": time.time()}
+            try:
+                os.makedirs(directory, exist_ok=True)
+                _telemetry.atomic_write_json(
+                    os.path.join(directory, endpoint_filename(self.rank)),
+                    doc, fsync=False,  # advisory discovery file
+                )
+            except OSError:
+                pass  # an unwritable dir must not take serving down
+
+    def close(self) -> None:
+        """Stop the HTTP server and drop the engine subscription (the pool
+        itself is untouched — a closed door does not evict anyone)."""
+        if self.autoscaler is not None:
+            _liveplane.unsubscribe(self.autoscaler.on_alert)
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+                self._thread = None
+
+    # - HTTP-side (rank 0, handler threads) -
+
+    def _validate(self, doc: dict) -> str | None:
+        from ..parallel import grid as _grid
+
+        model = doc.get("model")
+        if model is not None and model != self.loop.model_name:
+            return (
+                f"this pool serves {self.loop.model_name!r}, not {model!r}"
+            )
+        size = doc.get("size")
+        if size is not None:
+            gg = _grid.global_grid()
+            if list(size) != list(gg.nxyz_g):
+                return (
+                    f"size {list(size)} does not match the pool's global "
+                    f"grid {list(gg.nxyz_g)}"
+                )
+        params = doc.get("params")
+        if not isinstance(params, dict):
+            return "params must be an object with at least max_steps"
+        try:
+            if int(params.get("max_steps", 0)) < 1:
+                return f"params.max_steps must be >= 1 (got {params.get('max_steps')!r})"
+        except (TypeError, ValueError):
+            return f"params.max_steps must be an integer (got {params.get('max_steps')!r})"
+        tol = params.get("tol")
+        if tol is not None:
+            if not self.loop.info["residual"]:
+                return (
+                    f"{self.loop.model_name} has no PT residual; tol applies "
+                    f"to residual models only (use max_steps)"
+                )
+            try:
+                float(tol)
+            except (TypeError, ValueError):
+                return f"params.tol must be a number (got {tol!r})"
+        ic = params.get("ic_scale", 1.0)
+        try:
+            float(ic)
+        except (TypeError, ValueError):
+            return f"params.ic_scale must be a number (got {ic!r})"
+        return None
+
+    def handle_submit(self, doc: dict):
+        """One ``POST /v1/submit`` → ``(code, body, headers)``.  Validation
+        → 400 before admission ever runs; admission → 429 with
+        ``Retry-After``; accepted specs land in the pending queue the next
+        control sync broadcasts."""
+        tenant = str(doc.get("tenant") or "default")
+        _telemetry.counter("frontdoor.requests_total").inc()
+        err = self._validate(doc)
+        if err is not None:
+            _telemetry.counter("frontdoor.invalid_total").inc()
+            return 400, {"error": err}, {}
+        # Decision + append run under the SAME lock `_directives` holds
+        # when it flips `_refusing` and drains pending: every request is
+        # accounted exactly once (admitted XOR rejected), and every 202
+        # ever issued is either in the resize drain or was refused — the
+        # admission check is cheap here (TTL-cached view), so holding the
+        # door lock across it costs microseconds, not a snapshot.
+        with self._lock:
+            if self._refusing:
+                return self._reject_resizing(tenant)
+            decision = self.admission.check(tenant)
+            if not decision.admit:
+                _telemetry.event(
+                    "frontdoor.reject", tenant=tenant, reason=decision.reason,
+                    retry_after_s=round(decision.retry_after_s, 3),
+                )
+                return (
+                    429,
+                    {
+                        "error": "admission rejected",
+                        "reason": decision.reason,
+                        "retry_after_s": round(decision.retry_after_s, 3),
+                    },
+                    {"Retry-After": str(max(1, int(-(-decision.retry_after_s // 1))))},
+                )
+            params = doc.get("params", {})
+            spec = {
+                "tenant": tenant,
+                "params": {
+                    "max_steps": int(params["max_steps"]),
+                    "ic_scale": float(params.get("ic_scale", 1.0)),
+                    "tol": None if params.get("tol") is None else float(params["tol"]),
+                },
+            }
+            rid = f"r{self._next_request:06d}"
+            self._next_request += 1
+            spec["id"] = rid
+            self._requests[rid] = {
+                "id": rid, "tenant": tenant, "params": spec["params"],
+                "submitted_ts": time.time(), "member": None, "done": None,
+            }
+            self._pending.append(spec)
+            _telemetry.gauge("frontdoor.pending").set(len(self._pending))
+        _telemetry.event("frontdoor.admit", request=rid, tenant=tenant,
+                         **spec["params"])
+        return 202, {"request_id": rid}, {}
+
+    def _reject_resizing(self, tenant: str):
+        """Mid-resize 429: the pool is checkpointing for a restart — turn
+        traffic away cheaply (same ledger as every admission rejection)
+        until the relaunched door opens."""
+        retry = 5.0
+        _telemetry.counter("frontdoor.rejected_total").inc()
+        _telemetry.counter("frontdoor.rejected.resizing").inc()
+        _telemetry.frontdoor_tenant_counter(tenant, "rejected").inc()
+        _telemetry.gauge("frontdoor.backpressure").set(1)
+        _telemetry.event("frontdoor.reject", tenant=tenant,
+                         reason="resizing", retry_after_s=retry)
+        return (
+            429,
+            {"error": "resizing", "reason": "resizing",
+             "retry_after_s": retry},
+            {"Retry-After": str(int(-(-retry // 1)))},
+        )
+
+    def request_shutdown(self) -> None:
+        self._shutdown = True
+
+    def result_view(self, rid: str) -> dict | None:
+        with self._lock:
+            rec = self._requests.get(rid)
+            if rec is None:
+                return None
+            if rec["done"] is not None:
+                return {"request_id": rid, "status": "done", **rec["done"]}
+            if rec["member"] is None:
+                return {"request_id": rid, "status": "pending"}
+            return {
+                "request_id": rid, "status": "accepted",
+                "member": rec["member"],
+            }
+
+    def status_view(self) -> dict:
+        with self._lock:
+            total = len(self._requests)
+            done = sum(1 for r in self._requests.values() if r["done"])
+            pending = len(self._pending)
+        doc = {
+            "rank": self.rank,
+            "model": self.loop.model_name,
+            "rounds": self.loop.rounds,
+            "capacity": self.loop.capacity,
+            "queue_depth": len(self.loop.queue),
+            "active_members": self.loop.active_members,
+            "pending": pending,
+            "requests": {"total": total, "done": done},
+            "draining": self._drain_target,
+            "resizing": bool(self._refusing),
+        }
+        if self.autoscaler is not None:
+            doc["autoscaler"] = self.autoscaler.status()
+        return doc
+
+    # - the serving thread (every rank) -
+
+    def _build_state(self, ic_scale: float) -> tuple:
+        from ..parallel import grid as _grid
+
+        gg = _grid.global_grid()
+        state, _params = self.model.setup(
+            *gg.nxyz, init_grid=False, ic_scale=float(ic_scale),
+            **self.setup_kwargs,
+        )
+        return tuple(state)
+
+    def _directives(self) -> dict | None:
+        """Rank 0: compose this iteration's control message."""
+        doc: dict = {}
+        resize = self._maybe_autoscale()
+        with self._lock:
+            if resize is not None and "resize" in resize:
+                # refuse new submissions UNDER THE SAME LOCK that drains
+                # pending: `handle_submit` re-checks `_refusing` inside its
+                # locked append, so every 202 ever issued is either in this
+                # drain or was refused — nothing can slip into the gap
+                # behind the checkpointed ledger
+                self._refusing = "resizing"
+            if self._pending:
+                doc["admit"] = list(self._pending)
+                self._pending.clear()
+                _telemetry.gauge("frontdoor.pending").set(0)
+        if resize is not None:
+            doc.update(resize)
+        if self._shutdown:
+            doc["shutdown"] = True
+        return doc or None
+
+    def _maybe_autoscale(self) -> dict | None:
+        """Rank 0, heartbeat cadence: one autoscaler observation over the
+        live gauge view; returns ``{"drain": cap}`` or ``{"resize": plan}``
+        directives (or None)."""
+        if self.autoscaler is None:
+            return None
+        now = time.monotonic()
+        if self.loop.rounds == self._as_round and now - self._as_t < 0.25:
+            return None
+        self._as_round, self._as_t = self.loop.rounds, now
+        view = _admission.gauge_view(tick=False)
+        if self._drain_target is not None:
+            target = self._drain_target
+            # drained() = no member left in a retiring slot (which implies
+            # occupancy fits the target): the documented "stop admitting,
+            # finish in-flight, then reshard" readiness
+            if self.loop.drained(int(target["capacity"])):
+                plan = dict(target, reason="scale_down_drained")
+                return {"resize": plan}
+            return None
+        action = self.autoscaler.observe(view)
+        if action is None:
+            return None
+        plan = {
+            "nproc": action["target"]["nproc"],
+            "capacity": action["target"]["capacity"],
+            "rung": action["rung"],
+            "reason": action["action"],
+            "evidence": action["evidence"],
+        }
+        if action["action"] == "up":
+            return {"resize": plan}
+        # scale-down: drain first — stop admitting into retiring slots,
+        # let in-flight members finish, resize once occupancy fits
+        return {"drain": plan}
+
+    def _apply(self, msg: dict) -> str | None:
+        """Every rank: apply one control message in a fixed order
+        (admissions → drain → resize → shutdown)."""
+        for spec in msg.get("admit", []):
+            self._admit_spec(spec)
+        if "drain" in msg:
+            plan = msg["drain"]
+            self.loop.drain_above = int(plan["capacity"])
+            if self.rank == 0:
+                self._drain_target = plan
+            _telemetry.event("frontdoor.drain", **{
+                k: plan[k] for k in ("nproc", "capacity", "reason")
+                if k in plan
+            })
+        if "resize" in msg:
+            self._execute_resize(msg["resize"])
+            return "resize"
+        if msg.get("shutdown"):
+            _telemetry.event("frontdoor.shutdown")
+            return "shutdown"
+        return None
+
+    def _admit_spec(self, spec: dict) -> None:
+        params = spec["params"]
+        state = self._build_state(params.get("ic_scale", 1.0))
+        request = Request(
+            state=state,
+            max_steps=int(params["max_steps"]),
+            tenant=spec.get("tenant", "default"),
+            tol=params.get("tol"),
+        )
+        member = self.loop.submit(request)
+        if self.rank == 0:
+            with self._lock:
+                rec = self._requests.get(spec.get("id"))
+                if rec is not None:
+                    rec["member"] = member
+
+    def _harvest(self) -> None:
+        """Collect newly retired members: the collective digest, the
+        request ledger update and the latency ledger.  Iteration order is
+        the member id — deterministic on every rank, so the digest
+        collectives stay aligned."""
+        fresh = sorted(
+            m for m in self.loop.results if m not in self._seen_results
+        )
+        for member in fresh:
+            self._seen_results.add(member)
+            res = self.loop.results[member]
+            digest = None
+            if self.digest_results and res.state is not None:
+                digest = state_digest(res.state)
+            if self.rank != 0:
+                continue
+            with self._lock:
+                rec = next(
+                    (r for r in self._requests.values()
+                     if r["member"] == member),
+                    None,
+                )
+            if rec is None:
+                continue
+            latency = time.time() - rec["submitted_ts"]
+            rec["done"] = {
+                "result": res.status,
+                "steps": res.steps,
+                "residual": res.residual,
+                "digest": digest,
+                "latency_s": round(latency, 6),
+            }
+            _telemetry.counter("frontdoor.completed_total").inc()
+            _telemetry.histogram("frontdoor.request_seconds").record(latency)
+            _telemetry.tenant_histogram(rec["tenant"]).record(latency)
+            _telemetry.event(
+                "frontdoor.complete", request=rec["id"], member=member,
+                tenant=rec["tenant"], result=res.status, steps=res.steps,
+                latency_s=round(latency, 6),
+            )
+
+    def serve_rounds(self, max_rounds: int | None = None, *,
+                     idle_sleep: float = 0.02) -> str:
+        """Drive the pool until a directive ends it: returns ``"shutdown"``,
+        ``"resize"`` (checkpoint + plan written — exit with `RESIZE_STATUS`)
+        or ``"rounds"`` (``max_rounds`` iterations elapsed).  One control
+        sync per iteration on EVERY rank — the collective cadence is the
+        iteration count, which the synced state keeps rank-uniform.
+        """
+        from ..utils import resilience as _resilience
+
+        n = 0
+        while True:
+            directive = self._directives() if self.rank == 0 else None
+            msg = broadcast_control(directive)
+            outcome = self._apply(msg)
+            if outcome is not None:
+                return outcome
+            if self.loop.queue or self.loop.active_members:
+                # the stall injector hook (`IGG_FAULT_INJECT=stall:stepN`):
+                # the SLO-breach drill wedges the serving thread HERE and
+                # the admission thread must flip to 429s on its own
+                _resilience.get_fault_injector().maybe_stall(self.loop.rounds)
+                self.loop.run_round()
+                self._harvest()
+            else:
+                # a drained pool is idle, not stalled: keep the step-stall
+                # rule quiet while the door waits for traffic
+                if _telemetry.enabled():
+                    _telemetry.note_progress(
+                        "serving.round", self.loop.rounds, done=True
+                    )
+                time.sleep(idle_sleep)
+            n += 1
+            if max_rounds is not None and n >= max_rounds:
+                return "rounds"
+
+    # - resize execution + elastic resume -
+
+    def _frontdoor_meta(self) -> dict:
+        with self._lock:
+            return {
+                "next_request": self._next_request,
+                "requests": {
+                    rid: {
+                        "tenant": r["tenant"], "params": r["params"],
+                        "submitted_ts": r["submitted_ts"],
+                        "member": r["member"], "done": r["done"],
+                    }
+                    for rid, r in self._requests.items()
+                },
+            }
+
+    def _execute_resize(self, plan: dict) -> None:
+        """Every rank: checkpoint the pool + ledgers, publish the plan
+        (rank 0, atomically), stop the HTTP server.  The caller exits with
+        `RESIZE_STATUS`; the supervisor relaunches at ``plan``'s topology
+        and the new process runs `elastic_resume`."""
+        from ..utils import checkpoint as _checkpoint
+
+        with _tracing.trace_span("igg.frontdoor.resize",
+                                 nproc=plan.get("nproc"),
+                                 capacity=plan.get("capacity")):
+            if self.loop._state is None:
+                # an empty pool still resizes (scale-down at idle): prime
+                # it so there is a (blank) pool to checkpoint and restore
+                self.loop.prime(self._build_state(1.0))
+            extra = {
+                **self.loop._serving_meta(),
+                "frontdoor": self._frontdoor_meta(),
+                "resize": {k: plan[k] for k in ("nproc", "capacity", "rung",
+                                                "reason") if k in plan},
+            }
+            path = _checkpoint.save_checkpoint(
+                self.checkpoint_dir, self.loop._state, self.loop.rounds,
+                extra=extra,
+            )
+            if self.rank == 0:
+                plan_doc = {
+                    **{k: plan[k] for k in ("nproc", "capacity", "rung",
+                                            "reason") if k in plan},
+                    "checkpoint": path,
+                    "rounds": self.loop.rounds,
+                    "ts": time.time(),
+                }
+                # fsync'd: the supervisor's ONLY relaunch instruction — it
+                # must never be readable half-written after a power cut
+                _telemetry.atomic_write_json(
+                    os.path.join(self.checkpoint_dir, RESIZE_PLAN), plan_doc
+                )
+            _telemetry.counter("frontdoor.resizes_total").inc()
+            _telemetry.event(
+                "frontdoor.resize", checkpoint=path,
+                **{k: plan[k] for k in ("nproc", "capacity", "rung", "reason")
+                   if k in plan},
+            )
+        self.close()
+
+    def elastic_resume(self) -> bool:
+        """Restore pool + ledgers from the newest valid checkpoint onto the
+        CURRENT topology/capacity (module docstring).  Every rank calls it
+        (the restore and re-admissions are collective-bearing and driven
+        from the shared checkpoint metadata, so they are rank-uniform by
+        construction).  Returns False when no checkpoint exists."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import _batched
+        from ..parallel import grid as _grid
+        from ..utils import checkpoint as _checkpoint
+
+        if not self.checkpoint_dir:
+            raise ValueError("elastic_resume needs a checkpoint_dir")
+        latest = _checkpoint.latest_checkpoint(self.checkpoint_dir)
+        if latest is None:
+            return False
+        meta = _checkpoint.checkpoint_meta(latest)
+        serving_meta = meta.get("extra", {}).get("serving", {})
+        if serving_meta.get("model") != self.loop.model_name:
+            raise ValueError(
+                f"checkpoint {latest!r} is a {serving_meta.get('model')!r} "
+                f"pool; this loop serves {self.loop.model_name!r}"
+            )
+        gg = _grid.global_grid()
+        err = _grid.elastic_topology_error(meta["grid"], gg.checkpoint_meta())
+        if err is not None:
+            raise ValueError(
+                f"checkpoint {latest!r} cannot be elastically restored on "
+                f"the current grid: {err}"
+            )
+        saved_slots = serving_meta.get("slots", [])
+        blank = self._build_state(1.0)
+        self.loop.prime(blank)
+        zeros = tuple(jax.jit(jnp.zeros_like)(A) for A in blank)
+        like = _batched.stack_states([zeros] * max(1, len(saved_slots)))
+        state, step, extra = _checkpoint.restore_checkpoint(
+            latest, like=like, strict=False, verify=True
+        )
+        active = [
+            (k, rec) for k, rec in enumerate(extra["serving"]["slots"])
+            if rec["active"]
+        ]
+        if len(active) > self.loop.capacity:
+            raise RuntimeError(
+                f"checkpoint holds {len(active)} live member(s) but the "
+                f"resized pool has capacity {self.loop.capacity} — drain "
+                f"below the target before scaling down."
+            )
+        for k, rec in active:
+            self.loop.adopt(rec, _batched.member_state(state, k))
+        self.loop.rounds = int(step)
+        fd_meta = extra.get("frontdoor", {})
+        adopted = {int(rec["member"]) for _, rec in active}
+        requests = fd_meta.get("requests", {})
+        # Still-QUEUED members (admitted by the door, never slotted, not
+        # done) are rebuilt from their specs under their original ids —
+        # the member state is a pure function of (grid, ic_scale), so
+        # nothing is lost with the queue.  Sorted by member id: the
+        # rank-uniform order every rank replays identically.
+        queued = sorted(
+            (
+                (int(rec["member"]), rid, rec)
+                for rid, rec in requests.items()
+                if rec.get("member") is not None
+                and rec.get("done") is None
+                and int(rec["member"]) not in adopted
+            ),
+        )
+        for member, _rid, rec in queued:
+            params = rec["params"]
+            self.loop.enqueue_restored(
+                member,
+                Request(
+                    state=self._build_state(params.get("ic_scale", 1.0)),
+                    max_steps=int(params["max_steps"]),
+                    tenant=rec.get("tenant", "default"),
+                    tol=params.get("tol"),
+                ),
+            )
+        self.loop._next_member = max(
+            self.loop._next_member,
+            int(serving_meta.get("next_member", 0)),
+        )
+        # Belt and braces: a 202-accepted request with NO member yet (its
+        # spec was still pending when the resize checkpointed — the drain
+        # normally empties that set under the refusal lock) is submitted
+        # fresh from its spec; member-id assignment is deterministic, so
+        # every rank replaying the same sorted ledger agrees.
+        unsynced = sorted(
+            (rid, rec) for rid, rec in requests.items()
+            if rec.get("member") is None and rec.get("done") is None
+        )
+        for rid, rec in unsynced:
+            params = rec["params"]
+            member = self.loop.submit(Request(
+                state=self._build_state(params.get("ic_scale", 1.0)),
+                max_steps=int(params["max_steps"]),
+                tenant=rec.get("tenant", "default"),
+                tol=params.get("tol"),
+            ))
+            rec["member"] = member
+        if self.rank == 0:
+            with self._lock:
+                self._next_request = max(
+                    self._next_request, int(fd_meta.get("next_request", 0))
+                )
+                for rid, rec in requests.items():
+                    self._requests[rid] = {
+                        "id": rid,
+                        "tenant": rec.get("tenant", "default"),
+                        "params": rec["params"],
+                        "submitted_ts": rec.get("submitted_ts", time.time()),
+                        "member": rec.get("member"),
+                        "done": rec.get("done"),
+                    }
+            # members that already retired stay harvested; the restored
+            # ledger answers /v1/result for them without their states
+        self._seen_results.update(
+            int(rec["member"]) for rec in requests.values()
+            if rec.get("done") is not None and rec.get("member") is not None
+        )
+        _telemetry.counter("frontdoor.resumes_total").inc()
+        _telemetry.event(
+            "frontdoor.resume", checkpoint=latest, mode="elastic",
+            adopted=len(active), requeued=len(queued), rounds=int(step),
+        )
+        return True

@@ -222,6 +222,12 @@ class ServingLoop:
         self.queue: collections.deque[tuple[int, Request]] = collections.deque()
         self.results: dict[int, MemberResult] = {}
         self.rounds = 0
+        # Graceful drain (ISSUE 12, `serving.frontdoor`): when set, slots
+        # with index >= drain_above are RETIRING — `_admit_from_queue`
+        # stops placing members there, in-flight members finish normally,
+        # and once `drained(capacity)` holds the pool can reshard down to
+        # that capacity without dropping anyone.
+        self.drain_above: int | None = None
         self._next_member = 0
         self._state = None  # built lazily from the first admitted state
         self._blank = None  # zero member state for freed slots
@@ -233,12 +239,29 @@ class ServingLoop:
         # belong to earlier runs and must not replay as escalations.
         self._alert_seq, _ = _liveplane.alerts_since(float("inf"))
         _liveplane.ensure_server()
+        self._publish_gauges()
 
     # -- pool state -----------------------------------------------------------
 
     @property
     def active_members(self) -> int:
         return sum(s.active for s in self.slots)
+
+    def _publish_gauges(self) -> None:
+        """The ONE writer of the pool-occupancy gauge family (ISSUE 12
+        satellite: ``serving.queue_depth`` used to be set in both `submit`
+        and the admit path — every mutation now routes through here, and
+        retirement updates the gauges immediately instead of at the next
+        admit).  ``/healthz`` serves these in its ``serving`` section; the
+        front door's admission controller and autoscaler key on them."""
+        _telemetry.gauge("serving.queue_depth").set(len(self.queue))
+        _telemetry.gauge("serving.active_members").set(self.active_members)
+        _telemetry.gauge("serving.capacity").set(self.capacity)
+
+    def drained(self, capacity: int) -> bool:
+        """No member occupies a slot at/above ``capacity`` — the scale-down
+        readiness check (`serving.autoscale`)."""
+        return all(not s.active for s in self.slots[capacity:])
 
     def _ensure_pool(self, like_state: tuple) -> None:
         """Build the B-slot pool from the first member's field signature."""
@@ -312,9 +335,62 @@ class ServingLoop:
         member = self._next_member
         self._next_member += 1
         self.queue.append((member, request))
-        _telemetry.gauge("serving.queue_depth").set(len(self.queue))
         self._admit_from_queue()
         return member
+
+    def enqueue_restored(self, member: int, request: Request) -> None:
+        """Re-queue a member under its ORIGINAL id (the front door's
+        elastic-resume path: members that were still queued when a resize
+        checkpointed are rebuilt from their request parameters and must
+        keep their ids so results stay addressable).  Validation mirrors
+        `submit`; the id counter advances past the restored id."""
+        if int(request.max_steps) < 1:
+            raise ValueError(
+                f"max_steps must be >= 1 (got {request.max_steps})"
+            )
+        self._check_signature(request.state)
+        self._next_member = max(self._next_member, int(member) + 1)
+        self.queue.append((int(member), request))
+        self._admit_from_queue()
+
+    def adopt(self, rec: dict, state: tuple) -> int:
+        """Place a RESTORED member (slot metadata dict from
+        `_serving_meta`, state sliced out of a restored pool) into the
+        first free non-retiring slot, preserving its member id, tenant,
+        step count and budget — the elastic-resume path that re-admits
+        live members into a resized pool without losing convergence
+        state.  Returns the slot index; raises when no slot is free."""
+        self._check_signature(tuple(state))
+        self._ensure_pool(tuple(state))
+        for k, slot in enumerate(self.slots):
+            if slot.active:
+                continue
+            if self.drain_above is not None and k >= self.drain_above:
+                continue
+            self._state = _batched.set_member_state(
+                self._state, tuple(state), k
+            )
+            self.slots[k] = _Slot(
+                member=int(rec["member"]), tenant=rec.get("tenant", ""),
+                max_steps=int(rec["max_steps"]), tol=rec.get("tol"),
+                steps=int(rec.get("steps", 0)), active=True,
+            )
+            if self.guard_policy == "rollback":
+                self.slots[k].snapshot = _batched.member_state(self._state, k)
+                self.slots[k].snapshot_steps = self.slots[k].steps
+            self._next_member = max(self._next_member, int(rec["member"]) + 1)
+            _telemetry.event(
+                "serving.admit", member=int(rec["member"]), slot=k,
+                tenant=rec.get("tenant", ""), max_steps=int(rec["max_steps"]),
+                tol=rec.get("tol"), resumed=True,
+            )
+            self._publish_gauges()
+            return k
+        raise RuntimeError(
+            f"adopt: no free slot for restored member {rec.get('member')} "
+            f"(capacity {self.capacity}, drain_above {self.drain_above}) — "
+            f"drain the pool below the target capacity before resizing down."
+        )
 
     def _admit_from_queue(self) -> None:
         for k, slot in enumerate(self.slots):
@@ -322,6 +398,8 @@ class ServingLoop:
                 break
             if slot.active:
                 continue
+            if self.drain_above is not None and k >= self.drain_above:
+                continue  # retiring slot: never admit into it again
             member, req = self.queue.popleft()
             self._ensure_pool(req.state)
             self._state = _batched.set_member_state(
@@ -340,8 +418,7 @@ class ServingLoop:
                 "serving.admit", member=member, slot=k, tenant=req.tenant,
                 max_steps=int(req.max_steps), tol=tol,
             )
-        _telemetry.gauge("serving.active_members").set(self.active_members)
-        _telemetry.gauge("serving.queue_depth").set(len(self.queue))
+        self._publish_gauges()
 
     # -- retirement -----------------------------------------------------------
 
@@ -373,6 +450,7 @@ class ServingLoop:
         # retired member's fields into a future snapshot/result.
         self._state = _batched.set_member_state(self._state, self._blank, k)
         self.slots[k] = _Slot()
+        self._publish_gauges()
         if self._residual_fn is not None and not any(
             s.active and s.tol is not None for s in self.slots
         ):
@@ -668,5 +746,5 @@ class ServingLoop:
             if self.guard_policy == "rollback" and self.slots[k].active:
                 self.slots[k].snapshot = _batched.member_state(self._state, k)
                 self.slots[k].snapshot_steps = self.slots[k].steps
-        _telemetry.gauge("serving.active_members").set(self.active_members)
+        self._publish_gauges()
         return True

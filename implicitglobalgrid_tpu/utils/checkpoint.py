@@ -625,17 +625,20 @@ def _restore_elastic(path, meta, gg, like):
                 f"Checkpoint {path!r} holds no blocks for field {i}."
             )
 
-        if bshape == gshape:
-            # Fully replicated field: one block IS the global value.
+        if bshape == gshape and (
+            like is None or tuple(tuple(like)[i].shape) == gshape
+        ):
+            # Fully replicated field — or a grid field whose SAVED grid had
+            # one block per dim and whose target keeps the same extents:
+            # either way one block IS the global value.  A one-block GRID
+            # field headed for a decomposed target (`like` with a different
+            # shape — the scale-UP restore) falls through to the
+            # reassembly path below, which duplicates the new overlap
+            # regions the one-block layout never stored twice.
             block = blocks[(0,) * len(gshape)]
             sharding = (
                 tuple(like)[i].sharding if like is not None else replicated_target
             )
-            if like is not None and tuple(tuple(like)[i].shape) != gshape:
-                raise ValueError(
-                    f"Checkpoint field {i} has global shape {gshape} but "
-                    f"`like[{i}]` has {tuple(tuple(like)[i].shape)}."
-                )
             state.append(
                 jax.make_array_from_callback(
                     gshape, sharding, lambda index, b=block: b[index]
@@ -652,9 +655,28 @@ def _restore_elastic(path, meta, gg, like):
                 f"{len(blocks)} across {len(npzs)} shard file(s); the "
                 f"checkpoint is incomplete."
             )
+        # Leading non-grid axes (a batched serving pool's ensemble axis B,
+        # `models._batched`): replicated across the mesh, so every block
+        # spans the full extent.  They participate in the reassembly as
+        # degenerate grid dims — 1 block, overlap 0, aperiodic — which
+        # makes every formula below collapse to the identity on them.
+        lead = max(0, ndim - len(nxyz_s))
+        if lead and bshape[:lead] != gshape[:lead]:
+            raise ValueError(
+                f"Checkpoint {path!r} field {i}: leading axis extents "
+                f"{bshape[:lead]} per block vs {gshape[:lead]} global — "
+                f"only UNSHARDED leading (batch) axes are elastically "
+                f"restorable."
+            )
+        nxyz_sf = bshape[:lead] + nxyz_s
+        over_sf = (0,) * lead + over_s
+        periods_f = (0,) * lead + periods
+        nxyz_tf = bshape[:lead] + tuple(gg.nxyz)
+        over_tf = (0,) * lead + tuple(gg.overlaps)
+        dims_tf = (1,) * lead + tuple(gg.dims)
         # Per-dim overlap of THIS field under the saved grid (shape-aware:
         # staggered n+1 fields carry overlap+1), then the de-dup extent.
-        ols_s = tuple(bshape[d] - (nxyz_s[d] - over_s[d]) for d in range(ndim))
+        ols_s = tuple(bshape[d] - (nxyz_sf[d] - over_sf[d]) for d in range(ndim))
         if any(o < 0 for o in ols_s):
             raise ValueError(
                 f"Checkpoint {path!r} field {i} (local shape {bshape}) does "
@@ -662,20 +684,20 @@ def _restore_elastic(path, meta, gg, like):
                 f"{ols_s}); elastic restore cannot reassemble it."
             )
         glens = tuple(
-            _gather.dedup_length(nblocks[d], bshape[d], ols_s[d], bool(periods[d]))
+            _gather.dedup_length(nblocks[d], bshape[d], ols_s[d], bool(periods_f[d]))
             for d in range(ndim)
         )
         glob = _gather.assemble_dedup(
-            blocks, bshape, nblocks, ols_s, periods[:ndim], dtype
+            blocks, bshape, nblocks, ols_s, periods_f[:ndim], dtype
         )
 
         # Target layout: the field keeps its stagger offset relative to the
         # grid's local size (e.g. a +1-staggered Vx stays +1-staggered).
         tshape = tuple(
-            gg.nxyz[d] + (bshape[d] - nxyz_s[d]) for d in range(ndim)
+            nxyz_tf[d] + (bshape[d] - nxyz_sf[d]) for d in range(ndim)
         )
         ols_t = tuple(
-            tshape[d] - (gg.nxyz[d] - gg.overlaps[d]) for d in range(ndim)
+            tshape[d] - (nxyz_tf[d] - over_tf[d]) for d in range(ndim)
         )
         if any(o < 0 for o in ols_t) or any(s < 1 for s in tshape):
             raise ValueError(
@@ -683,7 +705,7 @@ def _restore_elastic(path, meta, gg, like):
                 f"(overlaps {ols_t}) is not realizable on the current grid."
             )
         glens_t = tuple(
-            _gather.dedup_length(gg.dims[d], tshape[d], ols_t[d], bool(periods[d]))
+            _gather.dedup_length(dims_tf[d], tshape[d], ols_t[d], bool(periods_f[d]))
             for d in range(ndim)
         )
         if glens_t != glens:
@@ -692,7 +714,7 @@ def _restore_elastic(path, meta, gg, like):
                 f"{glens} under the save does not match {glens_t} under the "
                 f"current grid."
             )
-        new_gshape = tuple(gg.dims[d] * tshape[d] for d in range(ndim))
+        new_gshape = tuple(dims_tf[d] * tshape[d] for d in range(ndim))
         if like is not None:
             sharding = tuple(like)[i].sharding
             if tuple(tuple(like)[i].shape) != new_gshape:
@@ -704,7 +726,9 @@ def _restore_elastic(path, meta, gg, like):
         elif gg.nprocs == 1 and not gg.force_spmd:
             sharding = SingleDeviceSharding(gg.mesh.devices.flat[0])
         else:
-            sharding = NamedSharding(gg.mesh, P(*AXIS_NAMES[:ndim]))
+            sharding = NamedSharding(
+                gg.mesh, P(*((None,) * lead + AXIS_NAMES[: ndim - lead]))
+            )
 
         def lookup(index, glob=glob, tshape=tshape, ols_t=ols_t, glens=glens,
                    new_gshape=new_gshape):

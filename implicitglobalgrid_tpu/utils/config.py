@@ -121,6 +121,39 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           the ``slo.*`` gauges aggregate the last
                           `telemetry.SLO_WINDOWS` windows (read per window
                           rollover, like the other telemetry knobs)
+``IGG_SERVE_PORT``        serving front-door port (`serving.frontdoor`,
+                          docs/serving.md): 0 (the default when a
+                          `FrontDoor` is constructed without an explicit
+                          ``port``) binds an ephemeral port, published via
+                          the ``frontdoor.port`` gauge and a
+                          ``frontdoor.p<rank>.json`` endpoint file under
+                          ``IGG_TELEMETRY_DIR``; N > 0 binds exactly N.
+                          Rank 0 only — the front door is the cluster's
+                          single network entry
+``IGG_SERVE_HOST``        bind address of the front-door server (default
+                          ``127.0.0.1`` — loopback only; the submit
+                          endpoint is unauthenticated, widen deliberately)
+``IGG_TENANT_QUOTA``      per-tenant token-bucket arrival limit for the
+                          front door: ``RATE`` or ``RATE:BURST`` (requests
+                          per second sustained, bucket depth BURST >= 1,
+                          default burst = max(1, RATE)); unset = unlimited.
+                          Exhaustion rejects with 429 reason ``quota``
+``IGG_FRONTDOOR_QUEUE_MAX``  backpressure threshold (int >= 1): reject new
+                          requests with 429 reason ``backpressure`` while
+                          the ``serving.queue_depth`` gauge is at/above it
+                          (unset = 4x the pool capacity)
+``IGG_FRONTDOOR_SLO_P99_S``  SLO backpressure threshold (number > 0): reject
+                          with 429 reason ``slo`` while the live
+                          ``slo.serving.round_seconds.p99`` window exceeds
+                          it (unset = only active CRITICAL anomaly alerts
+                          flip the ``slo`` backpressure)
+``IGG_AUTOSCALE_QUEUE_HIGH``  sustained-queue scale-up threshold for the
+                          `serving.autoscale.Autoscaler` (int >= 1; unset =
+                          the pool capacity): queue depth at/above it votes
+                          ``up``
+``IGG_AUTOSCALE_SUSTAIN`` consecutive autoscaler observations (int >= 1,
+                          default 2) a non-``hold`` verdict must sustain
+                          before a resize commits
 ========================  ====================================================
 
 Explicit kwargs always win over env values; env values win over built-in
@@ -399,3 +432,73 @@ def slo_window_env() -> float | None:
     """``IGG_SLO_WINDOW_S``: rolling SLO sub-window length in seconds
     (> 0; unset = the `utils.telemetry.SLO_WINDOW_S_DEFAULT` default)."""
     return _float_env("IGG_SLO_WINDOW_S", exclusive_minimum=0)
+
+
+# -- Serving front-door knobs (read per construction; docs/serving.md) --------
+
+
+def serve_port_env() -> int | None:
+    """``IGG_SERVE_PORT``: front-door port (>= 0; 0 = ephemeral).  ``None``
+    = unset — `serving.frontdoor.FrontDoor` falls back to 0 (ephemeral)."""
+    return _int_env("IGG_SERVE_PORT", minimum=0)
+
+
+def serve_host_env() -> str | None:
+    """``IGG_SERVE_HOST``: front-door bind address (default loopback —
+    the consumer falls back to ``127.0.0.1`` when unset)."""
+    val = os.environ.get("IGG_SERVE_HOST")
+    return val or None
+
+
+def tenant_quota_env() -> tuple[float, float] | None:
+    """``IGG_TENANT_QUOTA``: per-tenant token-bucket arrival limit as
+    ``(rate_per_s, burst)``, or ``None`` when unset (= unlimited).
+
+    Format ``RATE`` or ``RATE:BURST`` — sustained RATE requests/second per
+    tenant with up to BURST (>= 1; default ``max(1, RATE)``) accumulated.
+    """
+    val = os.environ.get("IGG_TENANT_QUOTA")
+    if val is None or val == "":
+        return None
+    parts = val.split(":")
+    try:
+        if len(parts) not in (1, 2):
+            raise ValueError
+        rate = float(parts[0])
+        burst = float(parts[1]) if len(parts) == 2 else max(1.0, rate)
+    except ValueError:
+        raise ValueError(
+            f"Environment variable IGG_TENANT_QUOTA must be 'RATE' or "
+            f"'RATE:BURST' (decimal requests/second, e.g. '5' or '5:10'), "
+            f"got {val!r}."
+        )
+    if rate <= 0 or burst < 1:
+        raise ValueError(
+            f"Environment variable IGG_TENANT_QUOTA needs RATE > 0 and "
+            f"BURST >= 1, got {val!r}."
+        )
+    return rate, burst
+
+
+def frontdoor_queue_max_env() -> int | None:
+    """``IGG_FRONTDOOR_QUEUE_MAX``: queue-depth backpressure threshold
+    (>= 1; unset = the front door's 4x-capacity default)."""
+    return _int_env("IGG_FRONTDOOR_QUEUE_MAX", minimum=1)
+
+
+def frontdoor_slo_p99_env() -> float | None:
+    """``IGG_FRONTDOOR_SLO_P99_S``: round-latency p99 backpressure
+    threshold in seconds (> 0; unset = alerts-only SLO backpressure)."""
+    return _float_env("IGG_FRONTDOOR_SLO_P99_S", exclusive_minimum=0)
+
+
+def autoscale_queue_high_env() -> int | None:
+    """``IGG_AUTOSCALE_QUEUE_HIGH``: queue depth that votes for a scale-up
+    (>= 1; unset = the pool capacity)."""
+    return _int_env("IGG_AUTOSCALE_QUEUE_HIGH", minimum=1)
+
+
+def autoscale_sustain_env() -> int | None:
+    """``IGG_AUTOSCALE_SUSTAIN``: consecutive non-hold autoscaler verdicts
+    before a resize commits (>= 1, default 2)."""
+    return _int_env("IGG_AUTOSCALE_SUSTAIN", minimum=1)

@@ -196,6 +196,33 @@ def _health_tail(snap: dict, eng: "RuleEngine", active: list[dict]) -> dict:
         doc["serving"] = {
             "active_members": gauges["serving.active_members"],
             "queue_depth": gauges.get("serving.queue_depth"),
+            "capacity": gauges.get("serving.capacity"),
+        }
+    if "frontdoor.port" in gauges or "frontdoor.requests_total" in counters:
+        # The network-facing plane (serving.frontdoor, docs/serving.md):
+        # admission totals + per-reason rejects + per-tenant counters, so
+        # one /healthz scrape answers "who is being turned away and why".
+        rejected = {
+            name[len("frontdoor.rejected."):]: v
+            for name, v in counters.items()
+            if name.startswith("frontdoor.rejected.")
+        }
+        tenants: dict[str, dict] = {}
+        for name, v in counters.items():
+            if not name.startswith("frontdoor.tenant."):
+                continue
+            tenant, _, kind = name[len("frontdoor.tenant."):].rpartition(".")
+            if tenant:
+                tenants.setdefault(tenant, {})[kind] = v
+        doc["frontdoor"] = {
+            "port": gauges.get("frontdoor.port"),
+            "pending": gauges.get("frontdoor.pending"),
+            "backpressure": gauges.get("frontdoor.backpressure"),
+            "requests_total": counters.get("frontdoor.requests_total", 0),
+            "admitted_total": counters.get("frontdoor.admitted_total", 0),
+            "rejected_total": counters.get("frontdoor.rejected_total", 0),
+            "rejected": rejected,
+            "tenants": tenants,
         }
     slo = slo_view(snap)
     if slo:
@@ -216,9 +243,10 @@ def _health_tail(snap: dict, eng: "RuleEngine", active: list[dict]) -> dict:
 # -- rolling SLO gauges -------------------------------------------------------
 
 #: histogram-name suffixes promoted into the ``slo.*`` gauge family — the
-#: step-latency, throughput and serving-round families ROADMAP item 3 keys
-#: admission control on
-_SLO_SUFFIXES = ("step_seconds", "t_eff_gbs", "round_seconds")
+#: step-latency, throughput, serving-round and front-door request-latency
+#: families ROADMAP item 3 keys admission control on
+_SLO_SUFFIXES = ("step_seconds", "t_eff_gbs", "round_seconds",
+                 "request_seconds")
 
 
 def publish_slo_gauges(snap: dict | None = None) -> dict:
@@ -795,9 +823,10 @@ def _publish_endpoint(server: MetricsServer) -> None:
     }
     try:
         os.makedirs(directory, exist_ok=True)
-        path = os.path.join(directory, endpoint_filename(rank))
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(doc, f)
+        _telemetry.atomic_write_json(
+            os.path.join(directory, endpoint_filename(rank)), doc,
+            fsync=False,  # advisory discovery file
+        )
     except OSError:
         pass  # an unwritable dir must not take the run down
 

@@ -56,7 +56,10 @@ __all__ = [
     "gauge",
     "histogram",
     "tenant_counter",
+    "tenant_histogram",
+    "frontdoor_tenant_counter",
     "event",
+    "atomic_write_json",
     "snapshot",
     "telemetry_snapshot",
     "dump_metrics",
@@ -363,38 +366,81 @@ TENANT_OVERFLOW = "serving.tenant.__other__.steps"
 _TENANT_PREFIX, _TENANT_SUFFIX = "serving.tenant.", ".steps"
 
 
-def tenant_counter(tenant: str) -> Counter | _Noop:
-    """The ``serving.tenant.<tenant>.steps`` counter, cardinality-capped.
+def _capped_tenant_metric(registry: dict, factory, tenant: str,
+                          prefix: str, suffix: str, overflow: str):
+    """One cardinality-capped per-tenant series out of ``registry``.
 
     Tenant strings arrive from REQUESTS, so an uncapped per-tenant series
-    is an unbounded-memory hole (every distinct string a counter, forever).
+    is an unbounded-memory hole (every distinct string a metric, forever).
     At most ``IGG_TELEMETRY_MAX_TENANTS`` (default `MAX_TENANTS_DEFAULT`)
-    distinct tenant series are created; once the cap is reached, new
-    tenants fold into the shared `TENANT_OVERFLOW` series (existing
-    tenants keep their own).  The total step count across the family is
-    exact either way — only per-tenant attribution degrades past the cap.
+    distinct tenant series are created per (prefix, suffix) family; once
+    the cap is reached, new tenants fold into the shared ``overflow``
+    series (existing tenants keep their own).  Family totals stay exact
+    either way — only per-tenant attribution degrades past the cap.
+    Caller does NOT hold `_lock`.
     """
-    if not enabled():
-        return NOOP
-    name = f"{_TENANT_PREFIX}{tenant}{_TENANT_SUFFIX}"
+    name = f"{prefix}{tenant}{suffix}"
     with _lock:
-        m = _counters.get(name)
+        m = registry.get(name)
         if m is None:
             env = _config.telemetry_max_tenants_env()
             cap = MAX_TENANTS_DEFAULT if env is None else env
             distinct = sum(
                 1
-                for k in _counters
-                if k.startswith(_TENANT_PREFIX)
-                and k.endswith(_TENANT_SUFFIX)
-                and k != TENANT_OVERFLOW
+                for k in registry
+                if k.startswith(prefix) and k.endswith(suffix)
+                and k != overflow
             )
-            if name != TENANT_OVERFLOW and distinct >= cap:
-                name = TENANT_OVERFLOW
-                m = _counters.get(name)
+            if name != overflow and distinct >= cap:
+                name = overflow
+                m = registry.get(name)
             if m is None:
-                m = _counters[name] = Counter(name)
+                m = registry[name] = factory(name)
         return m
+
+
+def tenant_counter(tenant: str) -> Counter | _Noop:
+    """The ``serving.tenant.<tenant>.steps`` counter, cardinality-capped
+    (see `_capped_tenant_metric` for the fold-over contract)."""
+    if not enabled():
+        return NOOP
+    return _capped_tenant_metric(
+        _counters, Counter, tenant, _TENANT_PREFIX, _TENANT_SUFFIX,
+        TENANT_OVERFLOW,
+    )
+
+
+#: the fold-over series of the front door's per-tenant latency family
+FRONTDOOR_TENANT_OVERFLOW = "frontdoor.tenant.__other__.request_seconds"
+
+_FD_TENANT_PREFIX, _FD_TENANT_SUFFIX = "frontdoor.tenant.", ".request_seconds"
+
+
+def frontdoor_tenant_counter(tenant: str, kind: str) -> Counter | _Noop:
+    """``frontdoor.tenant.<tenant>.<kind>`` counter (``kind`` in
+    ``admitted``/``rejected`` — the per-tenant admission ledger the
+    ``/healthz`` frontdoor section and `scripts/igg_top.py`'s reject-rate
+    column read), cardinality-capped like `tenant_counter`."""
+    if not enabled():
+        return NOOP
+    return _capped_tenant_metric(
+        _counters, Counter, tenant, _FD_TENANT_PREFIX, f".{kind}",
+        f"frontdoor.tenant.__other__.{kind}",
+    )
+
+
+def tenant_histogram(tenant: str) -> Histogram | _Noop:
+    """The ``frontdoor.tenant.<tenant>.request_seconds`` histogram,
+    cardinality-capped like `tenant_counter` (the per-tenant submit→result
+    latency family of `serving.frontdoor`; its rolling window rides the
+    ``slo.*`` gauge publication because the name ends in
+    ``request_seconds``)."""
+    if not enabled():
+        return NOOP
+    return _capped_tenant_metric(
+        _histograms, Histogram, tenant, _FD_TENANT_PREFIX, _FD_TENANT_SUFFIX,
+        FRONTDOOR_TENANT_OVERFLOW,
+    )
 
 
 def reset() -> None:
@@ -584,6 +630,29 @@ def event(etype: str, **payload: Any) -> None:
         os.write(fd, line.encode())
     except OSError:
         pass  # a full/unwritable disk must not take the run down
+
+
+def atomic_write_json(path: str | os.PathLike, doc, *, fsync: bool = True,
+                      indent: int | None = None) -> str:
+    """Publish ``doc`` as JSON at ``path`` whole-or-not-at-all: write a
+    ``.tmp`` sibling, flush + (by default) fsync, then ONE ``os.replace``.
+
+    The shared crash-safety primitive behind every JSON artifact a consumer
+    discovers by path (bench round records, the front door's endpoint file
+    and ``resize.json``, the liveplane endpoint file) — round 5's bench
+    record was lost to a mid-capture crash precisely because its only copy
+    was a half-flushed stream.  ``fsync=False`` trades power-loss safety
+    for speed where the artifact is advisory.
+    """
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=indent, default=str)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
 
 
 def read_events(path: str | os.PathLike) -> list[dict]:

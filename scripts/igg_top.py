@@ -146,8 +146,30 @@ def _fmt(v, scale=1.0, suffix="", nd=1) -> str:
     return f"{v * scale:.{nd}f}{suffix}"
 
 
+def _reject_rate(frontdoor: dict) -> str | None:
+    """``"NN%(tenant)"`` — overall reject share, tagged with the worst
+    tenant by reject count (the per-tenant quota/backpressure attribution
+    the front door's capped counters carry)."""
+    admitted = frontdoor.get("admitted_total") or 0
+    rejected = frontdoor.get("rejected_total") or 0
+    total = admitted + rejected
+    if not total:
+        return None
+    pct = f"{100.0 * rejected / total:.0f}%"
+    tenants = frontdoor.get("tenants") or {}
+    worst = max(
+        tenants, key=lambda t: tenants[t].get("rejected", 0), default=None
+    )
+    if worst is not None and tenants[worst].get("rejected", 0) > 0:
+        return f"{pct}({worst})"
+    return pct
+
+
 def summary_rows(healths: dict[int, dict]) -> list[dict]:
-    """One summary row per rank from its ``/healthz`` document."""
+    """One summary row per rank from its ``/healthz`` document (incl. the
+    ``serving``/``frontdoor`` SLO columns — queue depth, pool occupancy,
+    round p50/p99, per-tenant reject rate — so one screen answers
+    "is serving healthy" across ranks, ISSUE 12)."""
     rows = []
     for rank in sorted(healths):
         h = healths[rank]
@@ -158,7 +180,19 @@ def summary_rows(healths: dict[int, dict]) -> list[dict]:
         teff = next(
             (s for n, s in slo.items() if n.endswith("t_eff_gbs")), {}
         )
+        rnd = next(
+            (s for n, s in slo.items() if n.endswith("round_seconds")), {}
+        )
+        serving = h.get("serving") or {}
+        frontdoor = h.get("frontdoor") or {}
         active = h.get("alerts", {}).get("active", [])
+        occupancy = None
+        if serving.get("active_members") is not None:
+            cap = serving.get("capacity")
+            occupancy = (
+                f"{serving['active_members']:.0f}/{cap:.0f}"
+                if cap is not None else f"{serving['active_members']:.0f}"
+            )
         rows.append(
             {
                 "rank": rank,
@@ -170,6 +204,11 @@ def summary_rows(healths: dict[int, dict]) -> list[dict]:
                 "p99_ms": (step.get("p99") or 0) * 1e3 if step else None,
                 "teff_gbs": teff.get("p50") if teff else None,
                 "skew": h.get("skew", {}).get("step_seconds_max_over_min"),
+                "queue": serving.get("queue_depth"),
+                "members": occupancy,
+                "rnd_p50_ms": (rnd.get("p50") or 0) * 1e3 if rnd else None,
+                "rnd_p99_ms": (rnd.get("p99") or 0) * 1e3 if rnd else None,
+                "reject": _reject_rate(frontdoor),
                 "alerts": ",".join(
                     f"{a['rule']}({a['severity']})" for a in active
                 ) or "-",
@@ -181,7 +220,8 @@ def summary_rows(healths: dict[int, dict]) -> list[dict]:
 def render_table(rows: list[dict]) -> str:
     head = (
         f"{'rank':>4} {'ok':>4} {'step':>8} {'age':>8} {'p50':>9} "
-        f"{'p99':>9} {'T_eff':>9} {'skew':>6}  alerts"
+        f"{'p99':>9} {'T_eff':>9} {'skew':>6} {'queue':>6} {'mem':>7} "
+        f"{'rnd50':>8} {'rnd99':>8} {'rej':>10}  alerts"
     )
     lines = [head, "-" * len(head)]
     for r in rows:
@@ -192,7 +232,12 @@ def render_table(rows: list[dict]) -> str:
             f"{_fmt(r['p50_ms'], suffix='ms'):>9} "
             f"{_fmt(r['p99_ms'], suffix='ms'):>9} "
             f"{_fmt(r['teff_gbs'], suffix='GB', nd=2):>9} "
-            f"{_fmt(r['skew'], nd=2):>6}  {r['alerts']}"
+            f"{_fmt(r['skew'], nd=2):>6} "
+            f"{_fmt(r.get('queue'), nd=0):>6} "
+            f"{r.get('members') or '-':>7} "
+            f"{_fmt(r.get('rnd_p50_ms'), suffix='ms'):>8} "
+            f"{_fmt(r.get('rnd_p99_ms'), suffix='ms'):>8} "
+            f"{r.get('reject') or '-':>10}  {r['alerts']}"
         )
     return "\n".join(lines)
 

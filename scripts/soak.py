@@ -62,9 +62,32 @@ telemetry snapshot instead of private tallies.
   health view WHILE the loop is wedged (the scrape-time rule evaluation)
   and in that rank's event log afterwards.
 
-``--quick`` runs the ``elastic_failover`` drill, the ``serving`` smoke
-and the ``live_plane`` drill at small size — the fast smoke path
-(registered next to the tier-1 command in docs/testing.md).
+* ``frontdoor`` — the network-facing serving drill (ISSUE 12,
+  docs/serving.md): bursty multi-tenant load is driven through the REAL
+  HTTP front door (`serving.FrontDoor`, ephemeral ``IGG_SERVE_PORT=0``)
+  of a diffusion serving pool.  The supervisor proves, in one run:
+  (a) admission control is LIVE — an injected serving-thread stall
+  (``stall:step1``) flips the door into SLO backpressure within one
+  rule-engine tick, observed as real 429s with ``reason="slo"`` AND as
+  ``igg_frontdoor_rejected_slo`` in a mid-stall ``/metrics`` scrape;
+  (b) elastic scale-UP under traffic — the queue burst drives the
+  `serving.autoscale.Autoscaler` to checkpoint and exit with
+  ``RESIZE_STATUS``; the supervisor relaunches as a 2-process gloo pair
+  whose `FrontDoor.elastic_resume` reshards the batched pool, re-adopts
+  every live member mid-budget and rebuilds the queued ones, while new
+  requests keep arriving at the resized door; (c) graceful scale-DOWN —
+  once the queue drains the autoscaler drains the retiring slots and
+  resizes back to one process, live members crossing topologies again;
+  (d) ZERO members dropped and every request's final field BIT-IDENTICAL
+  to an undisturbed fixed-topology oracle (per-field sha256 digests of
+  the de-duplicated global state); (e) p50/p99 submit→result latency and
+  rounds/s recorded (``frontdoor_soak.json``) — the same metric names
+  ``bench.py``'s ``frontdoor_serving`` extra gates.
+
+``--quick`` runs the ``elastic_failover`` drill, the ``serving`` smoke,
+the ``live_plane`` drill and the ``frontdoor`` drill at small size — the
+fast smoke path (registered next to the tier-1 command in
+docs/testing.md).
 """
 
 from __future__ import annotations
@@ -78,9 +101,10 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
-CRASH_STATUS = 17  # FaultInjector.CRASH_STATUS
+CRASH_STATUS = 17   # FaultInjector.CRASH_STATUS
+RESIZE_STATUS = 19  # serving.frontdoor.RESIZE_STATUS
 SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash",
-             "elastic_failover", "serving", "live_plane")
+             "elastic_failover", "serving", "live_plane", "frontdoor")
 
 
 def _free_port() -> int:
@@ -346,6 +370,491 @@ def child_live_main(args) -> int:
     igg.finalize_global_grid()
     print("SOAK CHILD OK", flush=True)
     return 0
+
+
+def _frontdoor_grid_args(args):
+    """(nxyz, grid_kwargs) for one frontdoor worker at ``args.nproc`` —
+    the same implied global grid at every rung (the elastic contract):
+    2-proc dims (2,1,1) local ``nx^3``; 1-proc local ``(2*nx-2, nx, nx)``."""
+    if args.nproc > 1:
+        return (args.nx, args.nx, args.nx), dict(
+            init_distributed=True,
+            distributed_kwargs=dict(
+                coordinator_address=f"127.0.0.1:{args.port}",
+                num_processes=args.nproc,
+                process_id=args.pair_id,
+            ),
+        )
+    return (2 * args.nx - 2, args.nx, args.nx), {}
+
+
+def child_frontdoor_main(args) -> int:
+    """One serving process of the frontdoor drill: pool + front door at the
+    given rung, optionally elastically resumed from the resize checkpoint.
+    Exits 0 on a broadcast shutdown, RESIZE_STATUS after writing a resize
+    plan — the supervisor relaunches at the plan's topology."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    if args.nproc > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import (
+        RESIZE_STATUS as _RS, AutoscalePolicy, Autoscaler, FrontDoor, Rung,
+        ServingLoop,
+    )
+    from implicitglobalgrid_tpu.utils import resilience
+
+    pid = args.pair_id
+    resilience.arm_watchdog(max(30, args.timeout - 40), exit=True)
+    nxyz, grid_kwargs = _frontdoor_grid_args(args)
+    igg.init_global_grid(*nxyz, quiet=(pid != 0), **grid_kwargs)
+    _, params = diffusion3d.setup(*nxyz, init_grid=False)
+    ladder = [
+        Rung(*(int(x) for x in rung.split(":")))
+        for rung in args.ladder.split(",")
+    ]
+    loop = ServingLoop(diffusion3d, params, capacity=args.capacity,
+                       steps_per_round=1)
+    policy = AutoscalePolicy.from_env(ladder)
+    fd = FrontDoor(
+        loop,
+        checkpoint_dir=args.ckpt_dir,
+        autoscaler=Autoscaler(policy, rung=args.rung),
+    )
+    if args.resume:
+        assert fd.elastic_resume(), "resume requested but no checkpoint found"
+    outcome = fd.serve_rounds(idle_sleep=0.05)
+    fd.close()
+    igg.dump_trace()
+    igg.finalize_global_grid()
+    print(f"SOAK FRONTDOOR CHILD {outcome}", flush=True)
+    return _RS if outcome == "resize" else 0
+
+
+def child_frontdoor_oracle(args) -> int:
+    """The undisturbed fixed-topology oracle: run every distinct request
+    spec through a plain 1-process `ServingLoop` (no HTTP, no resizes) and
+    dump each final field's digest — the bit-identity reference."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    import json as _json
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import Request, ServingLoop
+    from implicitglobalgrid_tpu.serving.frontdoor import state_digest
+
+    with open(args.specs) as f:
+        specs = _json.load(f)  # [[ic_scale, max_steps], ...]
+    nxyz = (2 * args.nx - 2, args.nx, args.nx)
+    igg.init_global_grid(*nxyz, quiet=True)
+    _, params = diffusion3d.setup(*nxyz, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=max(2, len(specs)),
+                       steps_per_round=1)
+    members = {}
+    for ic, ms in specs:
+        state, _ = diffusion3d.setup(*nxyz, init_grid=False, ic_scale=ic)
+        members[f"{ic}:{ms}"] = loop.submit(
+            Request(state=state, max_steps=int(ms))
+        )
+    loop.run(max_rounds=10 * max(ms for _, ms in specs))
+    digests = {}
+    for key, m in members.items():
+        res = loop.results[m]
+        assert res.status == "completed", (key, res.status)
+        digests[key] = state_digest(res.state)["fields"]
+    with open(args.out, "w") as f:
+        _json.dump(digests, f)
+    igg.finalize_global_grid()
+    print("SOAK FRONTDOOR ORACLE OK", flush=True)
+    return 0
+
+
+class _DoorClient:
+    """Tiny HTTP client for the drill: submit with 429-aware retries, poll
+    results, scrape metrics — everything deadline-bounded."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+
+    def _url(self, path):
+        return f"http://{self.endpoint}{path}"
+
+    def post(self, path, doc):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._url(path), data=__import__("json").dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, __import__("json").load(r)
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, __import__("json").load(e)
+            except ValueError:
+                return e.code, {}
+        except OSError:
+            # door down (mid-resize restart): report unreachable, let the
+            # caller retry against the next phase's endpoint
+            return 0, {}
+
+    def get(self, path):
+        import urllib.request
+
+        with urllib.request.urlopen(self._url(path), timeout=5) as r:
+            body = r.read()
+        try:
+            return __import__("json").loads(body)
+        except ValueError:
+            return body.decode()
+
+    def metrics_text(self) -> str:
+        import urllib.request
+
+        with urllib.request.urlopen(self._url("/metrics"), timeout=5) as r:
+            return r.read().decode()
+
+
+def supervise_frontdoor(args) -> bool:
+    """The frontdoor drill supervisor (module docstring): three phases
+    across two elastic resizes, with the load generator, the stall-driven
+    backpressure check and the digest acceptance in one pass."""
+    import json as _json
+    import shutil
+    import time as _time
+
+    workdir = args.workdir
+    ckpt = os.path.join(workdir, "ckpt_frontdoor")
+    tele_dir = os.path.join(workdir, "telemetry_frontdoor")
+    shutil.rmtree(ckpt, ignore_errors=True)
+    shutil.rmtree(tele_dir, ignore_errors=True)
+    steps = max(4, args.steps)
+    cap1, cap2 = 2, 4
+    ladder = f"1:{cap1},2:{cap2}"
+    # request catalog: (tenant, ic_scale, max_steps).  The burst outruns
+    # cap1 so the queue drives the scale-up; the two long members are
+    # still LIVE when the queue later drains, so the scale-down must
+    # carry them across topologies mid-budget.
+    burst = [("tA", 1.0, steps), ("tB", 1.05, steps), ("tA", 1.1, steps),
+             ("tC", 1.15, steps), ("tB", 1.2, steps), ("tC", 1.25, steps)]
+    long_jobs = [("tA", 1.3, 3 * steps), ("tB", 1.35, 3 * steps)]
+    mid_traffic = [("t2proc", 1.4, steps)]  # submitted WHILE 2-proc
+    probe = ("probe", 1.0, 1)               # the stall-window hammer
+    all_specs = sorted({(ic, ms) for _, ic, ms in
+                        burst + long_jobs + mid_traffic + [probe]})
+
+    # (0) the undisturbed oracle's digests
+    specs_path = os.path.join(workdir, "frontdoor_specs.json")
+    oracle_out = os.path.join(workdir, "frontdoor_oracle.json")
+    with open(specs_path, "w") as f:
+        _json.dump([list(s) for s in all_specs], f)
+    proc = _run_child(
+        [sys.executable, os.path.abspath(__file__), "--frontdoor-oracle",
+         "--nx", str(args.nx), "--specs", specs_path, "--out", oracle_out],
+        _elastic_env({}), args.timeout,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        return _report("frontdoor", False, f"oracle rc={proc.returncode}")
+    with open(oracle_out) as f:
+        oracle = _json.load(f)
+
+    def _cmd(phase):
+        return [
+            sys.executable, os.path.abspath(__file__), "--frontdoor-child",
+            "--nx", str(args.nx), "--steps", str(steps),
+            "--nproc", str(phase["nproc"]), "--pair-id", "PID",
+            "--port", str(phase["port"]), "--ckpt-dir", ckpt,
+            "--capacity", str(phase["capacity"]), "--rung", str(phase["rung"]),
+            "--resume", str(int(phase["resume"])), "--ladder", ladder,
+            "--timeout", str(args.timeout),
+        ]
+
+    endpoint_file = os.path.join(tele_dir, "frontdoor.p0.json")
+    accepted: dict[str, dict] = {}  # rid -> {tenant, ic, ms, t}
+    done: dict[str, dict] = {}
+    to_submit: list[tuple] = []     # load not yet 202-accepted; survives
+    phase_log: list[dict] = []      # phase transitions (a resize may land
+    slo_429 = None                  # mid-burst — leftovers hit the next door)
+    slo_metrics_seen = False
+    shutdown_sent = False
+    logs_to_dump: list[str] = []
+
+    def _try_submit(client, tenant, ic, ms, phase_no) -> bool:
+        """ONE submit attempt; True iff 202-accepted (429/unreachable =
+        not yet — the caller keeps the spec queued)."""
+        code, body = client.post("/v1/submit", {
+            "tenant": tenant,
+            "model": "diffusion3d",
+            "params": {"ic_scale": ic, "max_steps": ms},
+        })
+        if code == 202:
+            accepted[body["request_id"]] = {
+                "tenant": tenant, "ic": ic, "ms": ms,
+                "t": _time.monotonic(), "phase": phase_no,
+            }
+            return True
+        return False
+
+    def _poll_done(client):
+        for rid in list(accepted):
+            if rid in done:
+                continue
+            try:
+                view = client.get(f"/v1/result/{rid}")
+            except OSError:
+                return
+            if isinstance(view, dict) and view.get("status") == "done":
+                view["t_done"] = _time.monotonic()
+                done[rid] = view
+
+    phase = {"nproc": 1, "capacity": cap1, "rung": 0, "resume": False,
+             "port": 0}
+    phase_no = 0
+    final_status = None
+    t_drill0 = _time.monotonic()
+    while True:
+        phase_no += 1
+        if phase_no > 6:
+            return _report("frontdoor", False,
+                           "more phases than the two expected resizes")
+        if phase["nproc"] > 1:
+            phase["port"] = _free_port()
+        try:
+            os.remove(endpoint_file)
+        except OSError:
+            pass
+        env_extra = {
+            "IGG_TELEMETRY": "1", "IGG_TELEMETRY_DIR": tele_dir,
+            "IGG_HEARTBEAT_EVERY": "1", "IGG_SERVE_PORT": "0",
+            "IGG_AUTOSCALE_QUEUE_HIGH": "3", "IGG_AUTOSCALE_SUSTAIN": "1",
+            "IGG_FRONTDOOR_QUEUE_MAX": "64",
+        }
+        if phase_no == 1:
+            # the SLO-breach leg: wedge the serving thread after round 1
+            env_extra["IGG_FAULT_INJECT"] = "stall:step1"
+        env = _elastic_env(env_extra)
+        logs = []
+        procs = []
+        for pid in range(phase["nproc"]):
+            log_path = os.path.join(workdir, f"frontdoor_p{phase_no}_{pid}.log")
+            logs.append(open(log_path, "w+"))
+            logs_to_dump.append(log_path)
+            cmd = [c if c != "PID" else str(pid) for c in _cmd(phase)]
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=logs[-1], stderr=subprocess.STDOUT,
+                text=True,
+            ))
+
+        def _fail(detail):
+            for q in procs:
+                q.kill()
+            for path in logs_to_dump[-phase["nproc"]:]:
+                with open(path) as f:
+                    print(f.read(), file=sys.stderr)
+            for f in logs:
+                f.close()
+            return _report("frontdoor", False, f"phase {phase_no}: {detail}")
+
+        # endpoint discovery (rank 0 publishes frontdoor.p0.json)
+        deadline = _time.monotonic() + args.timeout
+        client = None
+        while _time.monotonic() < deadline:
+            if any(q.poll() is not None for q in procs):
+                return _fail("a child exited before opening the front door")
+            if os.path.isfile(endpoint_file):
+                try:
+                    with open(endpoint_file) as f:
+                        doc = _json.load(f)
+                    client = _DoorClient(f"{doc['host']}:{doc['port']}")
+                    client.get("/v1/status")
+                    break
+                except (OSError, ValueError):
+                    client = None
+            _time.sleep(0.1)
+        if client is None:
+            return _fail("front-door endpoint never became reachable")
+
+        # phase-specific load
+        if phase_no == 1:
+            # two requests arm the pool (the stall fires after round 1)...
+            armed = 0
+            while armed < 2 and _time.monotonic() < deadline:
+                if _try_submit(client, *burst[armed], phase_no):
+                    armed += 1
+                else:
+                    _time.sleep(0.1)
+            if armed < 2:
+                return _fail("initial submissions never accepted")
+            # ...wait for round 1 (the stall wedges right after it) so the
+            # probes below cannot pile up as pending QUEUE load and trip
+            # the autoscaler before the stall leg has run...
+            while _time.monotonic() < deadline:
+                try:
+                    if (client.get("/v1/status").get("rounds") or 0) >= 1:
+                        break
+                except OSError:
+                    pass
+                _time.sleep(0.05)
+            # ...then hammer the door until the wedged serving thread shows
+            # up as a LIVE 429 reason="slo" + the counter in /metrics.  The
+            # wedge outlasts any resize decision (the serving thread IS the
+            # decision loop), so this completes before phase 1 can end.
+            while _time.monotonic() < deadline and slo_429 is None:
+                if any(q.poll() is not None for q in procs):
+                    return _fail(
+                        "children exited before the stall produced a 429"
+                    )
+                code, body = client.post("/v1/submit", {
+                    "tenant": probe[0], "model": "diffusion3d",
+                    "params": {"ic_scale": probe[1], "max_steps": probe[2]},
+                })
+                if code == 202:
+                    accepted[body["request_id"]] = {
+                        "tenant": probe[0], "ic": probe[1], "ms": probe[2],
+                        "t": _time.monotonic(), "phase": phase_no,
+                    }
+                elif code == 429 and body.get("reason") == "slo":
+                    slo_429 = body
+                    if "igg_frontdoor_rejected_slo" in client.metrics_text():
+                        slo_metrics_seen = True
+                _time.sleep(0.1)
+            if slo_429 is None:
+                return _fail("injected stall never produced a 429 reason=slo")
+            # the burst that outruns cap1 and drives the scale-up, plus the
+            # two long members the scale-down must later carry live (a
+            # resize may land mid-burst; leftovers hit the next door)
+            to_submit.extend(burst[2:] + long_jobs)
+        elif phase["nproc"] > 1:
+            # traffic THROUGH the resized (2-process) door
+            to_submit.extend(mid_traffic)
+
+        # drive until the phase ends (resize exit or everything done)
+        while _time.monotonic() < deadline:
+            if to_submit and _try_submit(client, *to_submit[0], phase_no):
+                to_submit.pop(0)
+            _poll_done(client)
+            if all(q.poll() is not None for q in procs):
+                break
+            if (
+                not shutdown_sent
+                and phase_no >= 3
+                and not to_submit
+                and len(done) == len(accepted)
+            ):
+                try:
+                    status = client.get("/v1/status")
+                    # a resumed door answers /v1/status BEFORE
+                    # elastic_resume restores the round counter: wait for
+                    # the restored figure so the rounds/s record is real
+                    if status.get("rounds"):
+                        final_status = status
+                        client.post("/v1/shutdown", {})
+                        shutdown_sent = True
+                except OSError:
+                    pass
+            _time.sleep(0.1)
+        for q in procs:
+            try:
+                q.wait(timeout=args.timeout)
+            except subprocess.TimeoutExpired:
+                return _fail("children did not exit")
+        for f in logs:
+            f.close()
+        rcs = [q.returncode for q in procs]
+        phase_log.append({"phase": phase_no, **{k: phase[k] for k in
+                                                ("nproc", "capacity", "rung")},
+                          "rcs": rcs})
+        if all(rc == RESIZE_STATUS for rc in rcs):
+            plan_path = os.path.join(ckpt, "resize.json")
+            try:
+                with open(plan_path) as f:
+                    plan = _json.load(f)
+            except (OSError, ValueError) as e:
+                return _fail(f"resize exit without a readable plan ({e!r})")
+            os.remove(plan_path)
+            phase = {"nproc": int(plan["nproc"]),
+                     "capacity": int(plan["capacity"]),
+                     "rung": int(plan["rung"]), "resume": True, "port": 0}
+            phase_log[-1]["plan"] = {k: plan[k] for k in
+                                     ("nproc", "capacity", "rung", "reason")}
+            continue
+        if all(rc == 0 for rc in rcs) and shutdown_sent:
+            break
+        return _fail(f"unexpected child rc(s) {rcs}")
+
+    # -- acceptance ----------------------------------------------------------
+    resize_plans = [p["plan"] for p in phase_log if "plan" in p]
+    ups = [p for p in resize_plans if p["reason"] == "up"]
+    downs = [p for p in resize_plans if "down" in p["reason"]]
+    if not (ups and ups[0]["nproc"] == 2):
+        return _report("frontdoor", False,
+                       f"no scale-UP to 2 processes (plans: {resize_plans})")
+    if not (downs and downs[0]["nproc"] == 1):
+        return _report("frontdoor", False,
+                       f"no scale-DOWN back to 1 process (plans: {resize_plans})")
+    if not slo_metrics_seen:
+        return _report("frontdoor", False,
+                       "frontdoor.rejected.slo never visible in /metrics")
+    missing = [rid for rid in accepted if rid not in done]
+    if missing:
+        return _report("frontdoor", False,
+                       f"{len(missing)} accepted request(s) never completed "
+                       f"(dropped members?): {missing[:5]}")
+    bad = []
+    for rid, meta in accepted.items():
+        digest = (done[rid].get("digest") or {}).get("fields")
+        want = oracle.get(f"{meta['ic']}:{meta['ms']}")
+        if digest != want:
+            bad.append(rid)
+    if bad:
+        return _report("frontdoor", False,
+                       f"digest mismatch vs the undisturbed oracle: {bad}")
+    if not any(m["phase"] == 2 for m in accepted.values()):
+        return _report("frontdoor", False,
+                       "no request was accepted during the 2-process phase")
+
+    lat = sorted(done[rid]["t_done"] - accepted[rid]["t"] for rid in accepted)
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1)))]
+    rounds = (final_status or {}).get("rounds") or 0
+    rps = rounds / max(1e-9, _time.monotonic() - t_drill0)
+    record = {
+        "requests": len(accepted),
+        "submit_to_result_p50_s": round(p50, 4),
+        "submit_to_result_p99_s": round(p99, 4),
+        "rounds": rounds,
+        "rounds_per_s": round(rps, 3),
+        "resizes": len(resize_plans),
+        "phases": phase_log,
+    }
+    with open(os.path.join(workdir, "frontdoor_soak.json"), "w") as f:
+        _json.dump(record, f, indent=1)
+    return _report(
+        "frontdoor", True,
+        f"{len(accepted)} requests across {len(phase_log)} phases "
+        f"(up@2proc + drain/down@1proc), all digests == oracle; stall -> "
+        f"429 reason=slo (+/metrics counter); p50 {p50:.2f}s p99 {p99:.2f}s "
+        f"{rps:.2f} rounds/s",
+    )
 
 
 def supervise_live_plane(args) -> bool:
@@ -873,7 +1382,7 @@ def orchestrate(args) -> int:
     # shared 8-device baseline is only needed by the other scenarios.
     baseline = None
     if any(
-        s not in ("elastic_failover", "serving", "live_plane")
+        s not in ("elastic_failover", "serving", "live_plane", "frontdoor")
         for s in args.scenarios
     ):
         proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
@@ -891,6 +1400,10 @@ def orchestrate(args) -> int:
             continue
         if scenario == "live_plane":
             if not supervise_live_plane(args):
+                failures += 1
+            continue
+        if scenario == "frontdoor":
+            if not supervise_frontdoor(args):
                 failures += 1
             continue
         if scenario == "serving":
@@ -989,15 +1502,23 @@ def main() -> int:
         help="fast smoke path: the elastic_failover drill (crash -> "
         "fallback past the corrupt generation -> shrunk-topology restart), "
         "the batched-serving loop smoke (mid-flight admit/retire, "
-        "per-member convergence masking) and the live_plane drill "
-        "(mid-run endpoint scrape + stall alert) at small size — the CI "
-        "lane registered in docs/testing.md",
+        "per-member convergence masking), the live_plane drill "
+        "(mid-run endpoint scrape + stall alert) and the frontdoor drill "
+        "(HTTP load + stall backpressure + elastic scale-up/down) at "
+        "small size — the CI lane registered in docs/testing.md",
     )
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--elastic-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--serving-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--live-child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--frontdoor-child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--frontdoor-oracle", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--capacity", type=int, default=2, help=argparse.SUPPRESS)
+    ap.add_argument("--rung", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--resume", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--ladder", default="1:2,2:4", help=argparse.SUPPRESS)
+    ap.add_argument("--specs", help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
     ap.add_argument("--distributed", action="store_true", help=argparse.SUPPRESS)
@@ -1013,10 +1534,15 @@ def main() -> int:
         return child_serving_main(args)
     if args.live_child:
         return child_live_main(args)
+    if args.frontdoor_child:
+        return child_frontdoor_main(args)
+    if args.frontdoor_oracle:
+        return child_frontdoor_oracle(args)
     if args.child:
         return child_main(args)
     if args.quick:
-        args.scenarios = ["elastic_failover", "serving", "live_plane"]
+        args.scenarios = ["elastic_failover", "serving", "live_plane",
+                          "frontdoor"]
         args.steps = min(args.steps, 6)
         args.timeout = min(args.timeout, 300)
     return orchestrate(args)

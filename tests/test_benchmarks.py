@@ -102,6 +102,12 @@ def test_bench_entrypoint_contract(monkeypatch, capsys):
     monkeypatch.setattr(
         bm._bench, "aot_weak_proxy", lambda emit=False: {"stub": True}
     )
+    # the front-door record drives a real serving pool + HTTP round trip
+    # (covered by tests/test_frontdoor.py) — stub it for the contract test
+    monkeypatch.setattr(
+        bm, "_frontdoor_serving_record",
+        lambda **kw: {"rounds_per_s": 1.0, "stub": True},
+    )
     import subprocess
     import types
 

@@ -130,6 +130,63 @@ def test_restore_resharded_periodic_dim(tmp_path):
     np.testing.assert_array_equal(np.asarray(T2x), np.asarray(T2))
 
 
+def test_restore_resharded_batched_leading_axis(tmp_path):
+    """A batched serving pool (leading ensemble axis B, replicated across
+    the mesh — `models._batched`) reshards elastically member-for-member:
+    the lead axis rides the reassembly as a degenerate grid dim (ISSUE 12,
+    the `FrontDoor.elastic_resume` substrate)."""
+    from implicitglobalgrid_tpu.models import _batched
+
+    igg.init_global_grid(NX, NX, NX, quiet=True)  # dims (2,2,2)
+
+    def member(s):
+        T0 = igg.zeros((NX, NX, NX))
+        X, Y, Z = igg.coord_fields(T0, (0.37, 0.11, 0.53))
+        return (X * s + Y * 0.7 + Z * 0.11,)
+
+    stack = _batched.stack_states([member(1.0), member(2.0)])
+    dd = [
+        np.asarray(igg.gather(_batched.member_field(stack[0], k), dedup=True))
+        for k in (0, 1)
+    ]
+    path = igg.save_checkpoint(tmp_path, stack, 5)
+    igg.finalize_global_grid()
+
+    igg.init_global_grid(5, NX, 14, dimx=4, dimy=2, dimz=1, quiet=True)
+    like = _batched.stack_states([(igg.zeros((5, NX, 14)),)] * 2)
+    (B2,), step, _ = ckpt.restore_checkpoint(path, like=like, strict=False)
+    assert step == 5 and B2.shape == (2, 20, 16, 14)
+    for k in (0, 1):
+        got = np.asarray(
+            igg.gather(_batched.member_field(B2, k), dedup=True)
+        )
+        assert got.tobytes() == dd[k].tobytes(), f"member {k}"
+
+
+def test_restore_scale_up_from_one_block_grid(tmp_path):
+    """Scale-UP: a checkpoint written on a dims-(1,1,1) grid (one block ==
+    the whole global array) must reshard onto a decomposed target — the
+    one-block field is a GRID field headed for duplication of the new
+    overlap regions, not a replicated scalar (the frontdoor drill's
+    1-proc -> 2-proc resize shape)."""
+    igg.init_global_grid(14, NX, NX, dimx=1, dimy=1, dimz=1, quiet=True,
+                         devices=jax.devices()[:1])
+    T, _ = _coord_state(tshape=(14, NX, NX), vshape=(15, NX, NX))
+    dd = igg.gather(T, dedup=True)
+    path = igg.save_checkpoint(tmp_path, (T,), 3)
+    igg.finalize_global_grid()
+
+    igg.init_global_grid(NX, NX, NX, dimx=2, dimy=1, dimz=1, quiet=True,
+                         devices=jax.devices()[:2])
+    like = (igg.zeros((NX, NX, NX)),)
+    (T2,), step, _ = ckpt.restore_checkpoint(path, like=like, strict=False)
+    assert step == 3 and T2.shape == (16, NX, NX)
+    assert igg.gather(T2, dedup=True).tobytes() == dd.tobytes()
+    # the duplicated overlap is consistent: an exchange is a bitwise no-op
+    T2x = igg.update_halo(T2 + 0)
+    np.testing.assert_array_equal(np.asarray(T2x), np.asarray(T2))
+
+
 def test_restore_resharded_thin_slab_offset_coord_collision(tmp_path):
     """Regression: with more blocks than cells-per-block along a dim (dims
     (8,1,1), local nx=5), a block's byte OFFSET tuple (e.g. (5,0,0)) equals

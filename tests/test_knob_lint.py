@@ -39,6 +39,12 @@ def test_lint_sees_the_known_knobs():
         "IGG_TELEMETRY_DIR",
         "IGG_HEARTBEAT_EVERY",
         "IGG_VMEM_MB",
+        # the serving front-door tier (ISSUE 12, docs/serving.md): these
+        # must stay in the census so an undocumented successor still fails
+        "IGG_SERVE_PORT",
+        "IGG_TENANT_QUOTA",
+        "IGG_FRONTDOOR_QUEUE_MAX",
+        "IGG_AUTOSCALE_SUSTAIN",
     ):
         assert knob in refs, f"{knob} vanished from the package scan"
 

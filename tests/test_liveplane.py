@@ -521,7 +521,13 @@ def test_igg_top_summary_rows_and_table():
             "slo": {
                 "diffusion3d.step_seconds": {"p50": 0.01, "p99": 0.015},
                 "diffusion3d.t_eff_gbs": {"p50": 123.0},
+                "serving.round_seconds": {"p50": 0.05, "p99": 0.2},
             },
+            "serving": {"active_members": 3, "queue_depth": 5,
+                        "capacity": 4},
+            "frontdoor": {"admitted_total": 9, "rejected_total": 3,
+                          "tenants": {"tA": {"admitted": 4, "rejected": 3},
+                                      "tB": {"admitted": 5}}},
             "alerts": {"active": []},
         },
     }
@@ -530,8 +536,16 @@ def test_igg_top_summary_rows_and_table():
     assert rows[0]["teff_gbs"] == 123.0 and rows[0]["alerts"] == "-"
     assert rows[1]["alerts"] == "step_stall(critical)"
     assert rows[1]["skew"] == 3.2
+    # the serving/frontdoor SLO columns (ISSUE 12): queue, occupancy,
+    # round p50/p99, per-tenant reject rate — absent rows stay "-"
+    assert rows[0]["queue"] == 5 and rows[0]["members"] == "3/4"
+    assert rows[0]["rnd_p50_ms"] == pytest.approx(50.0)
+    assert rows[0]["rnd_p99_ms"] == pytest.approx(200.0)
+    assert rows[0]["reject"] == "25%(tA)"
+    assert rows[1]["queue"] is None and rows[1]["reject"] is None
     table = igg_top.render_table(rows)
     assert "step_stall(critical)" in table and "ALRT" in table
+    assert "25%(tA)" in table and "3/4" in table
     assert len(table.splitlines()) == 4  # header + rule + 2 ranks
 
 
