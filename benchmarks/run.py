@@ -410,6 +410,88 @@ def bench_porous(n=128, chunk=4, reps=3, npt=10, dtype="float32", devices=None,
     )
 
 
+def bench_tuned_vs_default(model="diffusion", n=256, chunk=24, reps=3,
+                           dtype="float32", npt=12, overlap=None, period=None,
+                           emit=True):
+    """ISSUE 13: the autotuner's closed loop — time the DEFAULT-config
+    production chunk and the ``autotune=True`` chunk at the same point and
+    record the ratio.  ``tuned_speedup = t_default / t_tuned`` is a gated
+    perf key (`analysis.perf.GATED_KEYS`): a tuner that starts picking
+    slower-than-default configs (or a regression erasing a tuned win)
+    drops the ratio past the band and fails `scripts/check_perf.py`.
+
+    Both runs share one grid and start from fresh `setup` states; the
+    tuned build resolves through the winner cache (`IGG_TUNE_CACHE` — a
+    prior `igg_tune.py seed`/sweep makes this a pure cache hit, a cold
+    cache pays one short search, and the record says which happened).
+    """
+    import jax
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import (
+        acoustic3d,
+        diffusion3d,
+        porous_convection3d,
+    )
+    from implicitglobalgrid_tpu.utils import telemetry as _tele
+
+    mod, model_name, setup_kw = {
+        "diffusion": (diffusion3d, "diffusion3d", {}),
+        "acoustic": (acoustic3d, "acoustic3d", {}),
+        "porous": (porous_convection3d, "porous_convection3d",
+                   {"npt": npt}),
+    }[model]
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    okw = _grid_kwargs(overlap, period)
+    state, params = mod.setup(
+        n, n, n, dtype=jax.numpy.dtype(dtype), quiet=True, **setup_kw, **okw
+    )
+
+    def _counters():
+        snap = _tele.snapshot()
+        return {k: v for k, v in snap.get("counters", {}).items()
+                if k.startswith("tune.")}
+
+    before = _counters()
+    step_default = mod.make_multi_step(params, chunk, donate=False)
+    t_def, _, spread_def = _time_steps(step_default, state, chunk, reps)
+    step_tuned = mod.make_multi_step(params, chunk, donate=False,
+                                     autotune=True)
+    after = _counters()
+    state2, _ = mod.setup(n, n, n, dtype=jax.numpy.dtype(dtype),
+                          init_grid=False, **setup_kw)
+    t_tun, _, spread_tun = _time_steps(step_tuned, state2, chunk, reps)
+
+    from implicitglobalgrid_tpu import tuning
+
+    gg = igg.get_global_grid()
+    key = tuning.make_key(
+        model_name, gg.nxyz, jax.numpy.dtype(dtype), gg=gg,
+        extra={"npt": int(npt)} if model == "porous" else None,
+        nsteps=chunk,
+    )
+    entry = tuning.TuneCache().lookup(key)
+    igg.finalize_global_grid()
+    hits = after.get("tune.cache_hit", 0) - before.get("tune.cache_hit", 0)
+    rec = {
+        "model": model_name,
+        "n": n,
+        "tuned_speedup": round(t_def / t_tun, 4),
+        "t_default_ms": round(t_def * 1e3, 4),
+        "t_tuned_ms": round(t_tun * 1e3, 4),
+        "config": entry["config"] if entry else {},
+        "source": entry["source"] if entry else None,
+        "cache": "hit" if hits else "miss",
+        "spread": {"default": spread_def, "tuned": spread_tun},
+    }
+    if emit:
+        print(json.dumps({"metric": f"{model_name}_{n}_{dtype}_tuned_vs_default",
+                          "value": rec["tuned_speedup"], "unit": "x", **rec}),
+              flush=True)
+    return rec
+
+
 #: Standard member job length (steps) the members/s/chip figure normalizes
 #: to: members_per_s = B / (t_step * BATCH_JOB_STEPS) / nchips — a
 #: completed-standard-jobs-per-second rate, so the sweep is comparable
@@ -792,11 +874,16 @@ def main():
     p.add_argument("what", nargs="?", default="all",
                    choices=["diffusion", "acoustic", "porous", "weak",
                             "coalesce", "grad", "batch", "batch_hlo",
-                            "reconcile", "all"])
+                            "reconcile", "tuned", "all"])
+    p.add_argument("--model", default="diffusion",
+                   choices=["diffusion", "acoustic", "porous"],
+                   help="model for the tuned mode (tuned-vs-default A/B)")
     p.add_argument("--batch-sizes", default="1,2,4,8",
                    help="comma-separated B sweep for the batch mode")
     p.add_argument("--n", type=int, default=None)
-    p.add_argument("--chunk", type=int, default=25)
+    # None sentinel: per-mode defaults below (25 everywhere; 24 for the
+    # tuned A/B, whose cadence candidates need a ladder-divisible chunk)
+    p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--reps", type=int, default=4)
     p.add_argument("--dtype", default="float32")
     p.add_argument("--hide-comm", action="store_true")
@@ -822,6 +909,9 @@ def main():
                    help="model for the weak-scaling config (BASELINE config 4 "
                         "is porous weak scaling)")
     a = p.parse_args()
+    tuned_chunk = 24 if a.chunk is None else a.chunk
+    if a.chunk is None:
+        a.chunk = 25  # the historical default of every other mode
     kw = dict(chunk=a.chunk, reps=a.reps, dtype=a.dtype)
     if a.what in ("diffusion", "all"):
         bench_diffusion(n=a.n or 256, hide_comm=a.hide_comm, fused_k=a.fused_k,
@@ -870,6 +960,14 @@ def main():
         bench_diffusion_grad(n=a.n or 256, chunk=a.chunk, reps=a.reps,
                              dtype=a.dtype, fused_k=a.fused_k or 4,
                              overlap=a.overlap, period=a.period)
+    if a.what == "tuned":
+        # the other modes' default chunk (25) divides NO fused_k rung — the
+        # tuned A/B defaults to a cadence-friendly 24; an EXPLICIT --chunk
+        # (25 included) is always honored
+        bench_tuned_vs_default(
+            model=a.model, n=a.n or 256, chunk=tuned_chunk, reps=a.reps,
+            dtype=a.dtype, npt=a.npt, overlap=a.overlap, period=a.period,
+        )
 
 
 if __name__ == "__main__":

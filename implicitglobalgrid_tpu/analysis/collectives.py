@@ -414,6 +414,35 @@ def gather_plan_censuses(ctx: Context):
 register_census_provider(gather_plan_censuses)
 
 
+def tuning_plan_censuses(ctx: Context):
+    """The autotuner resolve's host-transport schedule per simulated rank.
+
+    `tuning.search.control_plan` is the single source of the resolve's
+    dispatch order (cache-decision broadcast, then — on a miss — the
+    measured candidates and the winner broadcast); its ``is_root``
+    parameter exists precisely so this census can prove the schedule
+    ignores rank identity AND rank-local cache state: a rank-keyed cache
+    lookup (one rank's local hit skipping the measurement collectives its
+    peers enter) is the `_gather_chunked` hang class wearing a tuner hat —
+    the seeded positive fixture in ``tests/test_tuning.py`` shows this
+    detector catching exactly that divergence.
+    """
+    from ..tuning.search import control_plan
+
+    for hit, n in ((True, 0), (False, 3), (False, 1), (False, 0)):
+        yield RankCensus(
+            name=f"host/tune_resolve[hit={hit},measured={n}]",
+            sequences={
+                rank: control_plan(is_root=(rank == 0), hit=hit,
+                                   n_measured=n)
+                for rank in range(4)
+            },
+        )
+
+
+register_census_provider(tuning_plan_censuses)
+
+
 def host_plan_findings(ctx: Context) -> list[Finding]:
     out = []
     for provider in list(CENSUS_PROVIDERS):

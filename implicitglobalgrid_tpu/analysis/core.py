@@ -240,6 +240,21 @@ REGISTRY: dict[str, AnalyzerSpec] = {
             paths=("BENCH_*.json", "bench.py", "benchmarks/**"),
             cost="ast",
         ),
+        AnalyzerSpec(
+            name="tune-cache-valid",
+            module="implicitglobalgrid_tpu.analysis.tunecache",
+            func="run",
+            title="committed autotuner seed entries parse against the "
+            "schema and hold currently-admissible configs "
+            "(tuning/entries, scripts/igg_tune.py)",
+            paths=(
+                "implicitglobalgrid_tpu/tuning/**",
+                # the envelopes ARE the admissibility ladder — a kernel
+                # constraint change must re-validate the committed winners
+                "implicitglobalgrid_tpu/ops/**",
+            ),
+            cost="ast",
+        ),
     )
 }
 

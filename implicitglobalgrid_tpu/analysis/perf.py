@@ -111,9 +111,13 @@ def load_bench_records(repo_root: str) -> tuple[list, list]:
 #: record (``extras.frontdoor_serving``, ISSUE 12): inverting the latency
 #: makes "p99 got slower" a one-sided DROP, so the existing gate catches
 #: it without new comparison semantics (the raw seconds ride along as
-#: `REPORTED_KEYS`).
+#: `REPORTED_KEYS`).  ``tuned_speedup`` is the autotuner's closed loop
+#: (``extras.tuned_vs_default``, ISSUE 13): t_default / t_tuned per model,
+#: so a tuner that starts picking slower-than-default configs (or a
+#: regression that erases a tuned win) drops the ratio and fails the gate
+#: the way a bandwidth drop does.
 GATED_KEYS = ("teff", "teff_grad", "members_per_s", "rounds_per_s",
-              "result_p50_per_s", "result_p99_per_s")
+              "result_p50_per_s", "result_p99_per_s", "tuned_speedup")
 
 
 def gate_metrics(record: dict) -> dict:

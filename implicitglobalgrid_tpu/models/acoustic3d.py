@@ -207,10 +207,27 @@ def pipelined_support_error(shape, k, itemsize: int = 4, bx=None, by=None,
     return _generic(pallas_leapfrog, shape, k, itemsize, bx, by, gg, stagger=1)
 
 
+def _tune_state(params: Params):
+    """Synthetic ones-filled state for autotuner candidate measurement
+    (`tuning.search`): linear updates on ones stay finite, and the fields
+    are real global-block sharded arrays (staggered ``n+1`` faces), so a
+    measured candidate runs the production SPMD program."""
+    from .. import ones
+    from ..parallel.grid import global_grid
+
+    nx, ny, nz = global_grid().nxyz
+    dt = params.dtype
+    return (
+        ones((nx, ny, nz), dt), ones((nx + 1, ny, nz), dt),
+        ones((nx, ny + 1, nz), dt), ones((nx, ny, nz + 1), dt),
+    )
+
+
 def make_multi_step(
     params: Params, nsteps: int, *, donate: bool = True, exchange_every: int = 1,
     fused_k: int | None = None, fused_tile: tuple[int, int] | None = None,
     pipelined: bool | None = None, batch: bool = False,
+    coalesce: bool | None = None, autotune: bool | None = None,
 ):
     """``nsteps`` leapfrog steps per call in one XLA program (`lax.fori_loop`).
 
@@ -246,8 +263,23 @@ def make_multi_step(
     ``batch``: vmap the whole cadence over a leading ensemble axis — every
     path batches through the same vmap with a B-invariant collective
     budget (see `models.diffusion3d.make_multi_step`).
+
+    ``coalesce`` (None = ``IGG_COALESCE``, auto): passed through to the
+    cadence's all-field exchanges (`ops.halo`; bit-identical either way —
+    the A/B-measurement knob, tunable per config).  ``autotune`` (None =
+    ``IGG_AUTOTUNE``, default off): substitute this point's cached winner
+    schedule into the kwargs above (`implicitglobalgrid_tpu.tuning`; pure
+    substitution — explicit kwargs always win, results bit-identical).
     """
     from jax import lax
+
+    from ..tuning.search import maybe_autotune
+
+    fused_k, fused_tile, exchange_every, pipelined, coalesce = maybe_autotune(
+        "acoustic3d", params, nsteps, autotune, batch=batch,
+        fused_k=fused_k, fused_tile=fused_tile, exchange_every=exchange_every,
+        pipelined=pipelined, coalesce=coalesce,
+    )
 
     def _wrap(block_fn):
         dn = tuple(range(4)) if donate else ()
@@ -409,7 +441,9 @@ def make_multi_step(
                 # steps (see the exchange_every docstring for why P's slab
                 # must ride along) — directly on the padded layout, so the
                 # chunk pays ONE pad/unpad instead of one per group.
-                return update_halo_padded_faces(*s, width=fused_k)
+                return update_halo_padded_faces(
+                    *s, width=fused_k, coalesce=coalesce
+                )
 
             P, Vxp, Vyp, Vzp = run_group_schedule(
                 groups, group, (P, *pad_faces(Vx, Vy, Vz))
@@ -445,7 +479,9 @@ def make_multi_step(
                 )
                 s, exports = out[:4], out[4:]
                 exports = fix_topface_z_exports(exports, *s, width=fused_k)
-                s = update_halo_padded_faces(*s, width=fused_k, dims=(0, 1))
+                s = update_halo_padded_faces(
+                    *s, width=fused_k, dims=(0, 1), coalesce=coalesce
+                )
                 patches = z_patches_from_exports(
                     exports, tuple(s[0].shape), width=fused_k
                 )
@@ -475,7 +511,8 @@ def make_multi_step(
             def boundary(ki, s):
                 out_b = kernel_steps(*s, tile_sel="ring" + sel)
                 pend = begin_slab_exchange(
-                    out_b, (0, 1), width=fused_k, logicals=logicals
+                    out_b, (0, 1), width=fused_k, logicals=logicals,
+                    coalesce=coalesce,
                 )
                 return out_b, pend
 
@@ -519,7 +556,8 @@ def make_multi_step(
                     tile_sel="ring" + sel,
                 )
                 pend = begin_slab_exchange(
-                    out_b[:4], (0, 1), width=fused_k, logicals=logicals
+                    out_b[:4], (0, 1), width=fused_k, logicals=logicals,
+                    coalesce=coalesce,
                 )
                 return out_b, pend
 
@@ -548,7 +586,7 @@ def make_multi_step(
         def xla_cadence_step(P, Vx, Vy, Vz):
             def group(i, s):
                 s = lax.fori_loop(0, fused_k, lambda j, s: xla_step(s), s)
-                return update_halo(*s, width=fused_k)
+                return update_halo(*s, width=fused_k, coalesce=coalesce)
 
             return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
 
@@ -560,7 +598,9 @@ def make_multi_step(
 
             def group(i, s):
                 s = lax.fori_loop(0, fused_k, lambda j, s: xla_step(s), s)
-                pend = begin_slab_exchange(s, (0, 1, 2), width=fused_k)
+                pend = begin_slab_exchange(
+                    s, (0, 1, 2), width=fused_k, coalesce=coalesce
+                )
                 return finish_slab_exchange(s, pend)
 
             return lax.fori_loop(0, nsteps // fused_k, group, (P, Vx, Vy, Vz))
@@ -609,9 +649,11 @@ def make_multi_step(
                         finish_slab_exchange,
                     )
 
-                    pend = begin_slab_exchange(s, (0, 1, 2), width=w)
+                    pend = begin_slab_exchange(
+                        s, (0, 1, 2), width=w, coalesce=coalesce
+                    )
                     return finish_slab_exchange(s, pend)
-                return update_halo(*s, width=w)
+                return update_halo(*s, width=w, coalesce=coalesce)
 
             return lax.fori_loop(0, nsteps // w, group, (P, Vx, Vy, Vz))
 
@@ -628,7 +670,7 @@ def make_multi_step(
     else:
 
         def v_exchange(P, Vx, Vy, Vz):
-            return update_halo(*v_update(P, Vx, Vy, Vz))
+            return update_halo(*v_update(P, Vx, Vy, Vz), coalesce=coalesce)
 
     def block_step(P, Vx, Vy, Vz):
         def body(i, s):

@@ -220,6 +220,18 @@ def pipelined_support_error(shape, k, itemsize: int = 4, bx=None, by=None,
     return _generic(pallas_stencil, shape, k, itemsize, bx, by, gg, stagger=0)
 
 
+def _tune_state(params: Params):
+    """Synthetic ones-filled state for autotuner candidate measurement
+    (`tuning.search`): the first steps are linear on ones (lap(1) = 0 — no
+    NaN risk) and the fields are real global-block sharded arrays, so a
+    measured candidate runs the production SPMD program."""
+    from .. import ones
+    from ..parallel.grid import global_grid
+
+    shape = tuple(global_grid().nxyz)
+    return ones(shape, params.dtype), ones(shape, params.dtype)
+
+
 def make_multi_step(
     params: Params,
     nsteps: int,
@@ -230,6 +242,8 @@ def make_multi_step(
     exchange_every: int = 1,
     pipelined: bool | None = None,
     batch: bool = False,
+    coalesce: bool | None = None,
+    autotune: bool | None = None,
 ):
     """Like `make_step` but advances ``nsteps`` steps per call via `lax.fori_loop`.
 
@@ -280,8 +294,32 @@ def make_multi_step(
     dimension), slab exchanges, pipelined begin/finish — batches through
     the same vmap, and the per-(dimension, width group) collective budget
     is B-invariant (pinned by `analysis.budget.batched_budget_findings`).
+
+    ``coalesce`` (None = the ``IGG_COALESCE`` env default, auto): the
+    cadence's multi-field exchanges pass it through to `ops.halo`
+    (bit-identical either way; the diffusion cadence exchanges a single
+    field except on the z-patch path, so the knob mostly matters to the
+    acoustic/porous siblings — it exists here so a tuned config is one
+    vocabulary across the three models).
+
+    ``autotune`` (None = ``IGG_AUTOTUNE`` env, default off): substitute the
+    cached winner config of this (backend, topology, model, local size,
+    dtype, batch) point into the kwargs above — searching (cost-model
+    pruned, short measured runs) and persisting it on first use
+    (`implicitglobalgrid_tpu.tuning`, docs/performance.md).  A pure
+    schedule substitution: results stay bit-identical to the default
+    config.  Explicitly-set kwargs always win — autotune only fills fields
+    left at their defaults.
     """
     from jax import lax
+
+    from ..tuning.search import maybe_autotune
+
+    fused_k, fused_tile, exchange_every, pipelined, coalesce = maybe_autotune(
+        "diffusion3d", params, nsteps, autotune, batch=batch,
+        fused_k=fused_k, fused_tile=fused_tile, exchange_every=exchange_every,
+        pipelined=pipelined, coalesce=coalesce,
+    )
 
     def _wrap(block_fn):
         dn = (0,) if donate else ()
@@ -432,7 +470,7 @@ def make_multi_step(
                 # the width-k exchange refreshes, and the sent planes
                 # [ol-k, ol) sit at distance >= k from the block edge,
                 # where k kernel steps are still exact.
-                return update_halo(T, width=fused_k)
+                return update_halo(T, width=fused_k, coalesce=coalesce)
 
             return run_group_schedule(groups, body, T), Cp
 
@@ -474,9 +512,12 @@ def make_multi_step(
                     T, zex = exchange_dims_multi(
                         (T, zex), (0, 1), width=fused_k,
                         logicals=(None, shape), axes=(None, _T_AXES),
+                        coalesce=coalesce,
                     )
                     return T, z_patch_from_export_t(zex, width=fused_k)
-                T, zex = exchange_dims_multi((T, zex), (0, 1), width=fused_k)
+                T, zex = exchange_dims_multi(
+                    (T, zex), (0, 1), width=fused_k, coalesce=coalesce
+                )
                 return T, z_patch_from_export(zex, width=fused_k)
 
             mk_ident = identity_z_patch_t if tr else identity_z_patch
@@ -500,7 +541,9 @@ def make_multi_step(
                 Tb = fused_diffusion_steps(
                     T, Cp, ki, cx, cy, cz, bx=bx, by=by, tile_sel="ring" + sel
                 )
-                return (Tb,), begin_slab_exchange((Tb,), (0, 1), width=fused_k)
+                return (Tb,), begin_slab_exchange(
+                    (Tb,), (0, 1), width=fused_k, coalesce=coalesce
+                )
 
             def interior(ki, T, b_out, pend):
                 T2 = fused_diffusion_steps(
@@ -544,7 +587,9 @@ def make_multi_step(
                     T, Cp, fused_k, cx, cy, cz, bx=bx, by=by, z_patch=patch,
                     z_export=True, z_overlap=o_z, tile_sel="ring" + sel,
                 )
-                pend = begin_slab_exchange(b_out[:1], (0, 1), width=fused_k)
+                pend = begin_slab_exchange(
+                    b_out[:1], (0, 1), width=fused_k, coalesce=coalesce
+                )
                 return b_out, pend
 
             def interior(ki, carry, b_out, pend):
@@ -556,7 +601,9 @@ def make_multi_step(
                 )
                 (T2,) = finish_slab_exchange((T2,), pend)
                 if tr:
-                    zex = exchange_dims_t(zex, width=fused_k, shape=shape)
+                    zex = exchange_dims_t(
+                        zex, width=fused_k, shape=shape, coalesce=coalesce
+                    )
                     return T2, z_patch_from_export_t(zex, width=fused_k)
                 zex = exchange_dims(zex, (0, 1), width=fused_k)
                 return T2, z_patch_from_export(zex, width=fused_k)
@@ -571,7 +618,7 @@ def make_multi_step(
         def xla_cadence_step(T, Cp):
             def group(i, T):
                 T = lax.fori_loop(0, fused_k, lambda j, T: update(T, Cp), T)
-                return update_halo(T, width=fused_k)
+                return update_halo(T, width=fused_k, coalesce=coalesce)
 
             return lax.fori_loop(0, nsteps // fused_k, group, T), Cp
 
@@ -585,7 +632,9 @@ def make_multi_step(
 
             def group(i, T):
                 T = lax.fori_loop(0, fused_k, lambda j, T: update(T, Cp), T)
-                pend = begin_slab_exchange((T,), (0, 1, 2), width=fused_k)
+                pend = begin_slab_exchange(
+                    (T,), (0, 1, 2), width=fused_k, coalesce=coalesce
+                )
                 (T,) = finish_slab_exchange((T,), pend)
                 return T
 
@@ -633,10 +682,12 @@ def make_multi_step(
                         finish_slab_exchange,
                     )
 
-                    pend = begin_slab_exchange((T,), (0, 1, 2), width=w)
+                    pend = begin_slab_exchange(
+                        (T,), (0, 1, 2), width=w, coalesce=coalesce
+                    )
                     (T,) = finish_slab_exchange((T,), pend)
                     return T
-                return update_halo(T, width=w)
+                return update_halo(T, width=w, coalesce=coalesce)
 
             T = lax.fori_loop(0, nsteps // w, group, T)
             return T, Cp
@@ -658,7 +709,7 @@ def make_multi_step(
     else:
 
         def one(T, Cp):
-            return update_halo(update(T, Cp))
+            return update_halo(update(T, Cp), coalesce=coalesce)
 
     def block_step(T, Cp):
         T = lax.fori_loop(0, nsteps, lambda i, T: one(T, Cp), T)

@@ -274,14 +274,16 @@ def _temperature_update(params: Params):
     return update
 
 
-def _build_block_step(params: Params):
+def _build_block_step(params: Params, coalesce: bool | None = None):
     """One whole time step (per-iteration exchange cadence), shared verbatim
     by `make_step` and `make_multi_step(exchange_every=1)` so the physics can
     never diverge between the two entry points: ``npt`` PT iterations
     (fori_loop, per-iteration ``Pf`` exchange), the once-per-step 3-field
     flux exchange (refreshing only the frozen face rings — the interior
     faces are already exact — to restore the duplicated-cells-agree
-    invariant for gather/visualization), then the T update + exchange."""
+    invariant for gather/visualization), then the T update + exchange.
+    ``coalesce``: forwarded to the multi-field flux exchange
+    (`make_multi_step`'s knob; None = the ``IGG_COALESCE`` default)."""
     from jax import lax
 
     pt_iter = _pt_iteration(params)
@@ -294,12 +296,30 @@ def _build_block_step(params: Params):
             return pt_iter(T, Pf, qDx, qDy, qDz)
 
         Pf, qDx, qDy, qDz = lax.fori_loop(0, npt, body, (Pf, qDx, qDy, qDz))
-        qDx, qDy, qDz = update_halo(qDx, qDy, qDz)
+        qDx, qDy, qDz = update_halo(qDx, qDy, qDz, coalesce=coalesce)
         T = t_update(T, qDx, qDy, qDz)
         T = update_halo(T)
         return T, Pf, qDx, qDy, qDz
 
     return block_step
+
+
+def _tune_state(params: Params):
+    """Synthetic ones-filled state for autotuner candidate measurement
+    (`tuning.search`): finite on ones (linear relaxations), real
+    global-block sharded fields — a measured candidate runs the production
+    SPMD program.  ``npt`` is part of the cache KEY (it changes numerics),
+    so the state carries no tuned physics."""
+    from .. import ones
+    from ..parallel.grid import global_grid
+
+    nx, ny, nz = global_grid().nxyz
+    dt = params.dtype
+    return (
+        ones((nx, ny, nz), dt), ones((nx, ny, nz), dt),
+        ones((nx + 1, ny, nz), dt), ones((nx, ny + 1, nz), dt),
+        ones((nx, ny, nz + 1), dt),
+    )
 
 
 def make_step(params: Params, *, donate: bool = True, batch: bool = False):
@@ -376,6 +396,8 @@ def make_multi_step(
     fused_tile: tuple[int, int] | None = None,
     pipelined: bool | None = None,
     batch: bool = False,
+    coalesce: bool | None = None,
+    autotune: bool | None = None,
 ):
     """Advance ``nsteps`` time steps per call in ONE XLA program
     (`lax.fori_loop` over whole time steps) — the production path: per-call
@@ -422,10 +444,26 @@ def make_multi_step(
     schedule; auto when admissible, see `pipelined_support_error`).
     ``pipelined=True`` also applies the early-dispatch exchange shape to
     the XLA cadences' group exchange.
+
+    ``coalesce`` (None = ``IGG_COALESCE``, auto): passed through to every
+    multi-field exchange of the cadence (`ops.halo`; bit-identical either
+    way — the per-field-attribution/A/B knob, tunable per config).
+    ``autotune`` (None = ``IGG_AUTOTUNE``, default off): substitute this
+    point's cached winner schedule into the kwargs above
+    (`implicitglobalgrid_tpu.tuning`; pure substitution — explicit kwargs
+    always win, results bit-identical).  ``npt`` is part of the tuning KEY,
+    never tuned: it changes the numerics, and tuning changes schedule only.
     """
     from jax import lax
 
     from ._fused import run_group_schedule
+    from ..tuning.search import maybe_autotune
+
+    fused_k, fused_tile, exchange_every, pipelined, coalesce = maybe_autotune(
+        "porous_convection3d", params, nsteps, autotune, batch=batch,
+        fused_k=fused_k, fused_tile=fused_tile, exchange_every=exchange_every,
+        pipelined=pipelined, coalesce=coalesce,
+    )
 
     t_update = _temperature_update(params)
     flux_update = _flux_update(params)
@@ -457,7 +495,7 @@ def make_multi_step(
         def block_step(T, Pf, qDx, qDy, qDz):
             s = (Pf, qDx, qDy, qDz)
             for _ in range(lead):
-                s = update_halo(*pt_iterate(T, s))
+                s = update_halo(*pt_iterate(T, s), coalesce=coalesce)
 
             # The small ki-iteration body is unrolled inside each group (a
             # nested fori_loop is the measured-slow shape); the group
@@ -478,9 +516,11 @@ def make_multi_step(
                         finish_slab_exchange,
                     )
 
-                    pend = begin_slab_exchange(s, (0, 1, 2), width=w)
+                    pend = begin_slab_exchange(
+                        s, (0, 1, 2), width=w, coalesce=coalesce
+                    )
                     return finish_slab_exchange(s, pend)
-                return update_halo(*s, width=w)
+                return update_halo(*s, width=w, coalesce=coalesce)
 
             s = run_group_schedule(
                 sched, group, s, unroll_limit=1, fori_excess_only=False
@@ -577,7 +617,8 @@ def make_multi_step(
 
                 for _ in range(lead):
                     Pf, qDx, qDy, qDz = update_halo(
-                        *pt_iterate(T, (Pf, qDx, qDy, qDz))
+                        *pt_iterate(T, (Pf, qDx, qDy, qDz)),
+                        coalesce=coalesce,
                     )
 
                 def group(ki, s):
@@ -589,7 +630,9 @@ def make_multi_step(
                     # every chunk: heals any chunk's stale rind; sent
                     # planes sit o-w >= w >= ki from the edge, so they are
                     # exact after ki iterations.
-                    return update_halo_padded_faces(*out, width=w)
+                    return update_halo_padded_faces(
+                        *out, width=w, coalesce=coalesce
+                    )
 
                 Pf, qxp, qyp, qzp = run_group_schedule(
                     chunks, group, (Pf, *pad_faces(qDx, qDy, qDz))
@@ -611,7 +654,8 @@ def make_multi_step(
 
                 for _ in range(lead):
                     Pf, qDx, qDy, qDz = update_halo(
-                        *pt_iterate(T, (Pf, qDx, qDy, qDz))
+                        *pt_iterate(T, (Pf, qDx, qDy, qDz)),
+                        coalesce=coalesce,
                     )
                 s0 = (Pf, *pad_faces(qDx, qDy, qDz))
                 o_z = ol(2, shape=tuple(Pf.shape), gg=gg)
@@ -631,7 +675,9 @@ def make_multi_step(
                     )
                     s, exports = out[:4], out[4:]
                     exports = fix_topface_z_exports(exports, *s, width=w)
-                    s = update_halo_padded_faces(*s, width=w, dims=(0, 1))
+                    s = update_halo_padded_faces(
+                        *s, width=w, dims=(0, 1), coalesce=coalesce
+                    )
                     patches = z_patches_from_exports(
                         exports, tuple(s[0].shape), width=w
                     )
@@ -657,7 +703,8 @@ def make_multi_step(
 
                 for _ in range(lead):
                     Pf, qDx, qDy, qDz = update_halo(
-                        *pt_iterate(T, (Pf, qDx, qDy, qDz))
+                        *pt_iterate(T, (Pf, qDx, qDy, qDz)),
+                        coalesce=coalesce,
                     )
                 sel, _, ptile = _split(tuple(Pf.shape), Pf.dtype.itemsize, False)
                 s0 = (Pf, *pad_faces(qDx, qDy, qDz))
@@ -666,7 +713,8 @@ def make_multi_step(
                 def boundary(ki, s):
                     out_b = kernel_iters(ki, T, *s, tile=ptile, tile_sel="ring" + sel)
                     pend = begin_slab_exchange(
-                        out_b, (0, 1), width=w, logicals=logicals
+                        out_b, (0, 1), width=w, logicals=logicals,
+                        coalesce=coalesce,
                     )
                     return out_b, pend
 
@@ -706,7 +754,8 @@ def make_multi_step(
 
                 for _ in range(lead):
                     Pf, qDx, qDy, qDz = update_halo(
-                        *pt_iterate(T, (Pf, qDx, qDy, qDz))
+                        *pt_iterate(T, (Pf, qDx, qDy, qDz)),
+                        coalesce=coalesce,
                     )
                 s0 = (Pf, *pad_faces(qDx, qDy, qDz))
                 o_z = ol(2, shape=tuple(Pf.shape), gg=gg)
@@ -722,7 +771,8 @@ def make_multi_step(
                         tile=ptile, tile_sel="ring" + sel,
                     )
                     pend = begin_slab_exchange(
-                        out_b[:4], (0, 1), width=w, logicals=logicals
+                        out_b[:4], (0, 1), width=w, logicals=logicals,
+                        coalesce=coalesce,
                     )
                     return out_b, pend
 
@@ -839,7 +889,7 @@ def make_multi_step(
                 "exchange_every > 1); the per-iteration path has no group "
                 "schedule."
             )
-        block_step = _build_block_step(params)
+        block_step = _build_block_step(params, coalesce=coalesce)
 
     # The Python unroll is only cheap for production-sized chunks; past this
     # the trace/HLO grows linearly (each step carries npt PT iterations) and

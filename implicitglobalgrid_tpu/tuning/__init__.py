@@ -1,0 +1,57 @@
+"""Autotuned kernel & schedule configs (ISSUE 13, docs/performance.md).
+
+The reference's pitch is "as fast as the hardware allows" via per-device
+tuned launch configs (its CUDA/ROCm backends pick kernel geometry per
+architecture); this package's translation is a cost-model-pruned search
+over the schedule kwargs the models already expose, with a versioned
+on-disk winner table so the search runs ONCE per (backend, topology,
+model, size, dtype, batch) point:
+
+* `space` — candidate enumeration + the static prior (the PR-7 cost-model
+  vocabulary: VMEM ladder via the kernel envelopes, modeled roofline
+  bytes, collective counts);
+* `cache` — the schema-checked atomic winner table (``IGG_TUNE_CACHE``
+  primary layer + the committed chip-measured seed layer
+  `cache.SEED_DIR`, ingested from ``BENCH_r*.json`` by ``igg_tune.py
+  seed``);
+* `search` — measurement, the SPMD-consistent rank-0-decides/broadcast
+  resolve, and the ``make_multi_step`` hook behind ``autotune=`` /
+  ``IGG_AUTOTUNE``.
+
+CLI: ``scripts/igg_tune.py`` (sweep / show / seed / clear).  Tier-1 gate:
+the ``tune-cache-valid`` analyzer (`analysis.tunecache`) over the
+committed seed layer, and ``bench.py``'s gated ``tuned_vs_default`` extra.
+"""
+
+from .cache import (  # noqa: F401
+    SCHEMA_VERSION,
+    SEED_DIR,
+    TuneCache,
+    admissibility_error,
+    default_cache_dir,
+    entry_filename,
+    key_digest,
+    make_key,
+    new_entry,
+    schedule_class,
+    seed_from_bench,
+    topology_string,
+    validate_entry,
+)
+from .search import (  # noqa: F401
+    apply_tuned_config,
+    autotune_requested,
+    control_plan,
+    measure_candidate,
+    project_config,
+    resolve_tuned_config,
+)
+from .space import (  # noqa: F401
+    CONFIG_FIELDS,
+    MODELS,
+    candidate_space,
+    modeled_cost,
+    modeled_seconds,
+    prune,
+    tile_ladder,
+)

@@ -1,0 +1,313 @@
+"""Candidate enumeration + the static cost-model prior of the autotuner.
+
+The search space is every performance knob the model entry points already
+expose as kwargs — ``fused_k`` (with tile ladder candidates from each
+kernel module's `default_tile` neighborhood), ``exchange_every``,
+``pipelined`` (where the ring/interior split is admissible), ``coalesce``
+(multi-field cadences only) — enumerated at one ``(model, local size,
+dtype, topology, batch)`` point.  A tuned config is therefore a PURE
+SUBSTITUTION of existing kwargs: it changes the *schedule* of a run, never
+its results (the bit-exactness contract `tests/test_tuning.py` pins on the
+oracle matrix).  Model-config parameters that change numerics — the porous
+``npt`` — are part of the cache KEY, never of the searched space.
+
+The prior is the PR-7 static cost model's vocabulary applied per candidate
+(`analysis.costmodel` gates the same quantities on the compiled matrix):
+
+* **buffer peaks vs the VMEM ladder** — each kernel module's
+  ``fused_support_error`` (backed by its ``_tile_bytes`` accounting and
+  `ops._fused_envelope.vmem_budget`, the ``IGG_VMEM_MB`` ladder) rejects a
+  candidate whose working set exceeds the per-core budget BEFORE it can
+  reach measurement;
+* **modeled ``bytes_accessed``** — the roofline HBM traffic per step
+  (streamed fields, divided by the temporal-blocking depth, multiplied by
+  the tile's halo-recompute redundancy) ranks the survivors;
+* **collective count** — hops per step (amortized by the slab cadence,
+  combined by coalescing) breaks ties with a nominal per-hop latency.
+
+The nominal constants (`RANK_BW_BYTES_PER_S`, `RANK_HOP_SECONDS`) only
+ORDER candidates — the measured short runs decide the winner — so their
+absolute calibration is deliberately unimportant.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+#: Nominal ranking constants (v5e-flavored; ordering-only, see module doc).
+RANK_BW_BYTES_PER_S = 819e9
+RANK_HOP_SECONDS = 1e-6
+
+#: The only fields a tuned config may carry — each one an existing
+#: ``make_multi_step`` kwarg on all three models (pure substitution).
+CONFIG_FIELDS = ("fused_k", "fused_tile", "exchange_every", "pipelined",
+                 "coalesce")
+
+#: Per-model enumeration facts: the kernel module behind ``fused_k``, the
+#: streamed-field census of the roofline model (fields read+written / read
+#: only per unit step), whether the cadence exchanges >= 2 fields (the
+#: ``coalesce`` knob is definitionally multi-field), and the tile-split
+#: stagger of the pipelined gate.
+MODELS = {
+    "diffusion3d": dict(
+        kernel="implicitglobalgrid_tpu.ops.pallas_stencil",
+        module="implicitglobalgrid_tpu.models.diffusion3d",
+        fields_rw=1, fields_ro=1, exchanged_fields=1, stagger=0,
+    ),
+    "acoustic3d": dict(
+        kernel="implicitglobalgrid_tpu.ops.pallas_leapfrog",
+        module="implicitglobalgrid_tpu.models.acoustic3d",
+        fields_rw=4, fields_ro=0, exchanged_fields=4, stagger=1,
+    ),
+    "porous_convection3d": dict(
+        kernel="implicitglobalgrid_tpu.ops.pallas_pt",
+        module="implicitglobalgrid_tpu.models.porous_convection3d",
+        fields_rw=4, fields_ro=1, exchanged_fields=4, stagger=1,
+    ),
+}
+
+#: Temporal-blocking depths probed per point (the kernels' envelope admits
+#: even k in [2, 8]; ``exchange_every`` reuses the shallow rungs).
+K_LADDER = (2, 4, 6, 8)
+EXCHANGE_LADDER = (2, 4)
+
+#: Explicit tiles enumerated per k beyond the auto pick (`default_tile`):
+#: the ladder is the module's own candidate neighborhood, deduplicated
+#: against the auto pick, capped to keep the space measurable.
+TILES_PER_K = 2
+
+
+def kernel_module(model: str):
+    return importlib.import_module(MODELS[model]["kernel"])
+
+
+def model_module(model: str):
+    return importlib.import_module(MODELS[model]["module"])
+
+
+def _active_dims(gg, shape):
+    from ..ops.halo import dim_has_halo_activity
+
+    if gg is None:
+        return ()
+    return tuple(d for d in range(3) if dim_has_halo_activity(gg, d))
+
+
+def _deep_halo_ok(w: int, gg, active) -> bool:
+    return all(gg.overlaps[d] >= 2 * w for d in active)
+
+
+def tile_ladder(model: str, shape, k: int, itemsize: int):
+    """Explicit tile candidates around the kernel's auto pick: the module's
+    own candidate neighborhood (``_candidates``/``_TILE_CANDIDATES``),
+    admissibility-filtered, auto-pick deduplicated, first `TILES_PER_K`."""
+    mod = kernel_module(model)
+    auto = mod.default_tile(shape, k, itemsize)
+    if hasattr(mod, "_candidates"):
+        cands = mod._candidates(shape, k)
+    else:
+        cands = mod._TILE_CANDIDATES
+    out = []
+    for t in cands:
+        if tuple(t) == auto or t in out:
+            continue
+        if mod.fused_support_error(shape, k, itemsize, t[0], t[1]) is None:
+            out.append(tuple(t))
+        if len(out) >= TILES_PER_K:
+            break
+    return auto, out
+
+
+def modeled_cost(model: str, shape, itemsize: int, config: dict, *,
+                 gg=None, npt: int | None = None) -> dict:
+    """The static prior of one candidate: modeled HBM ``bytes_per_step``
+    (roofline traffic, per time step — per PT iteration for porous, times
+    ``npt``), the kernel working set ``vmem_bytes`` (0 for XLA-cadence
+    candidates: XLA manages its own VMEM), and ``collectives_per_step``."""
+    from ..ops._fused_envelope import aligned_halo
+
+    facts = MODELS[model]
+    n0, n1, n2 = shape
+    vol = n0 * n1 * n2
+    rw, ro = facts["fields_rw"], facts["fields_ro"]
+    # npt scales the porous traffic linearly; it is constant across the
+    # candidates of one point, so ranking survives an unknown (None) npt
+    iters = (int(npt) if model == "porous_convection3d" and npt is not None
+             else 1)
+    k = config.get("fused_k")
+    w = k or config.get("exchange_every", 1) or 1
+    vmem = 0
+    if k:
+        mod = kernel_module(model)
+        tile = config.get("fused_tile")
+        if tile is None:
+            tile = mod.default_tile(shape, k, itemsize)
+        bx, by = tile
+        H = 0 if by == n1 else aligned_halo(k)
+        redundancy = ((bx + 2 * k) * (by + 2 * H)) / float(bx * by)
+        # One haloed read + one owned write per field per k steps.
+        bytes_per = (rw * (1 + redundancy) + ro * redundancy) * vol * itemsize / k
+        vmem = int(mod._tile_bytes(n1, n2, k, bx, by, itemsize))
+    else:
+        bytes_per = (2 * rw + ro) * vol * itemsize
+    active = _active_dims(gg, shape)
+    if active:
+        nf = facts["exchanged_fields"]
+        per_exchange = 2 * len(active) * (
+            nf if config.get("coalesce") is False or nf < 2 else 1
+        )
+        coll = per_exchange / float(w)
+    else:
+        coll = 0.0
+    return {
+        "bytes_per_step": round(bytes_per * iters, 2),
+        "vmem_bytes": vmem,
+        "collectives_per_step": round(coll * iters, 4),
+    }
+
+
+def modeled_seconds(modeled: dict) -> float:
+    """The ranking proxy (ordering-only, see module doc)."""
+    return (
+        modeled["bytes_per_step"] / RANK_BW_BYTES_PER_S
+        + modeled["collectives_per_step"] * RANK_HOP_SECONDS
+    )
+
+
+def candidate_space(model: str, shape, itemsize: int, *, nsteps: int,
+                    gg=None, npt: int | None = None):
+    """``(candidates, rejected)`` for one tuning point, deterministic order.
+
+    ``candidates``: admissible ``{"config", "modeled"}`` dicts, the default
+    (empty) config always FIRST — it is always measured, so the winner can
+    never be worse than what the caller would have run untuned.
+    ``rejected``: configs the prior refused with the reason (VMEM ladder,
+    divisibility, deep-halo precondition) — the dry-run table's left half
+    and the ``tune.candidates_pruned`` census.
+    """
+    if model not in MODELS:
+        raise ValueError(f"unknown model {model!r}; tunable: {sorted(MODELS)}")
+    shape = tuple(int(x) for x in shape)
+    facts = MODELS[model]
+    active = _active_dims(gg, shape)
+    porous = model == "porous_convection3d"
+    kmod = kernel_module(model)
+
+    bases: list[dict] = [{}]
+    rejected: list[dict] = []
+
+    def _steps_ok(w: int) -> str | None:
+        if porous:
+            # the PT cadence chunks npt, not nsteps (`_pt_schedule`)
+            if npt is not None and w > int(npt):
+                return f"w={w} exceeds npt={npt}: no PT chunk to amortize"
+            return None
+        if nsteps % w != 0:
+            return f"nsteps={nsteps} is not a multiple of {w}"
+        return None
+
+    # -- exchange_every rungs (slab cadence without the kernel) -----------
+    for w in EXCHANGE_LADDER:
+        cfg = {"exchange_every": w}
+        if not active:
+            rejected.append({"config": cfg, "error": "no halo activity: "
+                             "nothing to amortize"})
+            continue
+        err = _steps_ok(w)
+        if err is None and not _deep_halo_ok(w, gg, active):
+            err = f"deep-halo precondition overlap >= {2 * w} not met"
+        if err:
+            rejected.append({"config": cfg, "error": err})
+            continue
+        bases.append(cfg)
+
+    # -- fused_k x tile ladder x pipelined --------------------------------
+    for k in K_LADDER:
+        err = _steps_ok(k)
+        if err is None and porous and npt is not None:
+            from ..models.porous_convection3d import _pt_schedule
+
+            if not _pt_schedule(int(npt), k)[1]:
+                err = f"npt={npt} leaves no even kernel chunk at w={k}"
+        if err is None and active and not _deep_halo_ok(k, gg, active):
+            err = f"deep-halo precondition overlap >= {2 * k} not met"
+        if err is None:
+            # the envelope gate: VMEM ladder (IGG_VMEM_MB), alignment,
+            # divisibility — the same check the model's fallback uses
+            err = kmod.fused_support_error(shape, k, itemsize, None, None)
+        if err:
+            rejected.append({"config": {"fused_k": k}, "error": err})
+            continue
+        auto, tiles = tile_ladder(model, shape, k, itemsize)
+        for tile in [None] + tiles:
+            cfg = {"fused_k": k}
+            if tile is not None:
+                cfg["fused_tile"] = tile
+            bx, by = tile if tile is not None else (None, None)
+            split_err = _split_error(model, shape, k, itemsize, bx, by, gg,
+                                     npt=npt)
+            if split_err is None:
+                bases.append({**cfg, "pipelined": False})
+                bases.append({**cfg, "pipelined": True})
+            else:
+                bases.append(cfg)
+
+    # -- coalesce twins (multi-field cadences on communicating grids) -----
+    out = list(bases)
+    if facts["exchanged_fields"] >= 2 and active:
+        out += [{**cfg, "coalesce": False} for cfg in bases]
+
+    candidates = [
+        {"config": cfg,
+         "modeled": modeled_cost(model, shape, itemsize, cfg, gg=gg, npt=npt)}
+        for cfg in out
+    ]
+    return candidates, rejected
+
+
+def _split_error(model, shape, k, itemsize, bx, by, gg, npt=None):
+    """Why the ring/interior pipelined split cannot run, or None — the
+    model's own gate (`models.*.pipelined_support_error`)."""
+    mod = model_module(model)
+    kw = {"npt": npt} if model == "porous_convection3d" else {}
+    try:
+        return mod.pipelined_support_error(shape, k, itemsize, bx, by,
+                                           gg=gg, **kw)
+    except Exception as e:  # a gate crash must reject, not sink the sweep
+        return f"{type(e).__name__}: {e}"
+
+
+def prune(candidates, topk: int, *, vmem_budget_bytes: int | None = None):
+    """Cost-model pruning: ``(survivors, cut)``.
+
+    The default config (index 0) ALWAYS survives; the rest rank by
+    `modeled_seconds` and the best ``topk - 1`` join it.  An explicit
+    ``vmem_budget_bytes`` additionally refuses candidates whose modeled
+    working set exceeds it — the enumeration's envelope gate already
+    enforces the ``IGG_VMEM_MB`` ladder, this parameter lets callers (and
+    the pruning-correctness test) tighten it on injected candidates.
+    ``cut`` lists the refused candidates with reasons: a candidate over the
+    VMEM ladder must NEVER reach measurement.
+    """
+    if not candidates:
+        return [], []
+    if topk < 1:
+        raise ValueError(f"topk must be >= 1 (got {topk})")
+    default, rest = candidates[0], candidates[1:]
+    cut = []
+    kept = []
+    for c in rest:
+        if (
+            vmem_budget_bytes is not None
+            and c["modeled"].get("vmem_bytes", 0) > vmem_budget_bytes
+        ):
+            cut.append({**c, "error": (
+                f"modeled VMEM {c['modeled']['vmem_bytes']} B exceeds the "
+                f"budget {vmem_budget_bytes} B")})
+        else:
+            kept.append(c)
+    ranked = sorted(kept, key=lambda c: modeled_seconds(c["modeled"]))
+    survivors = [default] + ranked[: max(0, topk - 1)]
+    cut += [{**c, "error": "ranked below topk by the modeled prior"}
+            for c in ranked[max(0, topk - 1):]]
+    return survivors, cut

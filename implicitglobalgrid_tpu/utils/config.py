@@ -154,6 +154,28 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_AUTOSCALE_SUSTAIN`` consecutive autoscaler observations (int >= 1,
                           default 2) a non-``hold`` verdict must sustain
                           before a resize commits
+``IGG_AUTOTUNE``          default for the models' ``make_multi_step``
+                          ``autotune=`` kwarg (``implicitglobalgrid_tpu.
+                          tuning``; nonzero = on, unset/0 = off): on first
+                          use of a (backend, topology, model, size, dtype,
+                          batch) point, search the schedule-kwarg space
+                          (cost-model-pruned, short measured runs) and
+                          apply the cached winner on every later call —
+                          a pure substitution of existing kwargs, resolved
+                          host-side before tracing (docs/performance.md)
+``IGG_TUNE_CACHE``        primary directory of the autotuner's on-disk
+                          winner table (unset = ``~/.cache/
+                          implicitglobalgrid_tpu/tune``); the committed
+                          seed layer ``tuning/entries`` is always the
+                          read-only fallback — read per resolve
+``IGG_TUNE_TOPK``         total candidates measured per search (int >= 1,
+                          default 4; `tuning.space.prune` — the default
+                          config always counts among them, so ``1`` can
+                          only ever confirm the default)
+``IGG_TUNE_STEPS``        timed chunk calls per measured candidate (int >=
+                          1, default 3; `tuning.search.measure_candidate`
+                          — short by design, the bench harness owns
+                          publication-grade timing)
 ========================  ====================================================
 
 Explicit kwargs always win over env values; env values win over built-in
@@ -502,3 +524,36 @@ def autoscale_sustain_env() -> int | None:
     """``IGG_AUTOSCALE_SUSTAIN``: consecutive non-hold autoscaler verdicts
     before a resize commits (>= 1, default 2)."""
     return _int_env("IGG_AUTOSCALE_SUSTAIN", minimum=1)
+
+
+# -- Autotuning knobs (read per resolve, host-side; docs/performance.md) ------
+
+
+def autotune_env() -> bool | None:
+    """``IGG_AUTOTUNE``: default for ``make_multi_step(autotune=)``.
+
+    ``None`` = unset (off unless the kwarg says otherwise); resolved
+    host-side before any tracing, so the knob can never bind into a cached
+    executable (the knob-binding contract).
+    """
+    val = _int_env("IGG_AUTOTUNE")
+    return None if val is None else val > 0
+
+
+def tune_cache_env() -> str | None:
+    """``IGG_TUNE_CACHE``: primary winner-table directory (unset = the
+    per-user default, `tuning.cache.default_cache_dir`)."""
+    val = os.environ.get("IGG_TUNE_CACHE")
+    return val or None
+
+
+def tune_topk_env() -> int | None:
+    """``IGG_TUNE_TOPK``: total candidates measured per search, the default
+    config included (>= 1, default 4)."""
+    return _int_env("IGG_TUNE_TOPK", minimum=1)
+
+
+def tune_steps_env() -> int | None:
+    """``IGG_TUNE_STEPS``: timed chunk calls per measured candidate (>= 1,
+    default 3)."""
+    return _int_env("IGG_TUNE_STEPS", minimum=1)

@@ -367,6 +367,52 @@ assert sorted(_res) == [_m0, _m1], _res
 assert all(r.status == "completed" and r.steps == 1 for r in _res.values())
 assert _loop.rounds == 2, _loop.rounds  # slot reuse = one round per member
 
+# --- Autotuned config over the broadcast host transport (ISSUE 13): rank 0
+# holds a seeded winner cache, rank 1 an EMPTY one — the deliberately
+# rank-divergent disk state whose naive (rank-keyed) lookup is exactly the
+# deadlock class the collective-consistency analyzer pins.  The resolve
+# must let rank 0 alone decide and broadcast, so BOTH ranks build the
+# identical tuned cadence; the tuned run must then be bit-identical to the
+# default-config run over the same real gloo hops (tuning changes
+# schedule, never results).
+from implicitglobalgrid_tpu import tuning as _tuning
+
+_tdir = out_path + f".tune.p{pid}"
+_gg_now = igg.get_global_grid()
+_tkey = _tuning.make_key("diffusion3d", _gg_now.nxyz, params2.dtype,
+                         gg=_gg_now, nsteps=4)
+if pid == 0:
+    _tuning.TuneCache(primary=_tdir, fallbacks=()).store(
+        _tkey, _tuning.new_entry(_tkey, {"exchange_every": 2},
+                                 source="worker-seed"),
+    )
+os.environ["IGG_TUNE_CACHE"] = _tdir
+try:
+    from implicitglobalgrid_tpu.utils import telemetry as _tele
+
+    sdef, _ = diffusion3d.setup(NX, NX, NX, init_grid=False)
+    stun, _ = diffusion3d.setup(NX, NX, NX, init_grid=False)
+    step_def = diffusion3d.make_multi_step(params2, 4, donate=False)
+    _hits0 = _tele.snapshot()["counters"].get("tune.cache_hit", 0)
+    step_tun = diffusion3d.make_multi_step(params2, 4, donate=False,
+                                           autotune=True)
+    _snap_t = _tele.snapshot()["counters"]
+    # the broadcast decision was rank 0's HIT on every rank — rank 1's
+    # empty disk must not have triggered a search (no candidate measured)
+    assert _snap_t.get("tune.cache_hit", 0) - _hits0 == 1, _snap_t
+    assert _snap_t.get("tune.candidates_measured", 0) == 0, _snap_t
+    sdef = jax.block_until_ready(step_def(*sdef))
+    stun = jax.block_until_ready(step_tun(*stun))
+    Tdef = igg.gather(diffusion3d.temperature(sdef), root=ROOT)
+    Ttun = igg.gather(diffusion3d.temperature(stun), root=ROOT)
+    if jax.process_index() == ROOT:
+        assert np.array_equal(Tdef, Ttun), (
+            "broadcast-tuned cadence diverged from the default-config run "
+            "across the process boundary"
+        )
+finally:
+    del os.environ["IGG_TUNE_CACHE"]
+
 # --- hide_communication across the real process boundary (VERDICT r4 #3):
 # the overlap-scheduled exchange's ppermutes ride the same gloo hops.
 igg.finalize_global_grid(finalize_distributed=False)
