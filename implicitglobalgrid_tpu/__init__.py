@@ -172,14 +172,17 @@ __all__ = [
     "analysis",
     # batched multi-simulation serving (ISSUE 8; docs/api.md)
     "serving",
+    # self-healing run supervisor (docs/robustness.md)
+    "supervisor",
 ]
 
 
 def __getattr__(name):
-    # Lazy: the serving subsystem pulls the model zoo in; importing igg
-    # itself must stay light (mirrors `models.__getattr__`).
-    if name == "serving":
+    # Lazy: the serving subsystem pulls the model zoo in and the
+    # supervisor is host-orchestration-only; importing igg itself must
+    # stay light (mirrors `models.__getattr__`).
+    if name in ("serving", "supervisor"):
         import importlib
 
-        return importlib.import_module(".serving", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -443,6 +443,40 @@ def tuning_plan_censuses(ctx: Context):
 register_census_provider(tuning_plan_censuses)
 
 
+def supervisor_plan_censuses(ctx: Context):
+    """The supervised ranks' in-band recovery schedule per simulated rank.
+
+    `supervisor.policy.recovery_plan` is the single source of the
+    collective schedule applying one recovery directive implies (control
+    broadcast + the checkpoint barriers for the resize family, nothing
+    for out-of-band restarts); its ``is_root`` parameter exists precisely
+    so this census can prove the schedule ignores rank identity, and its
+    ``stale`` (fence) flag is rank-uniform by construction — a recovery
+    decision keyed on rank identity or rank-LOCAL fence state (one stale
+    rank skipping the checkpoint barriers its peers enter) is the
+    `_gather_chunked` hang class wearing a supervisor hat; the seeded
+    positive fixture in ``tests/test_supervisor.py`` shows this detector
+    catching exactly that divergence.
+    """
+    from ..supervisor.policy import ACTIONS, recovery_plan
+
+    for action in ACTIONS:
+        for stale in (False, True):
+            yield RankCensus(
+                name=f"host/supervisor_recovery[action={action},"
+                f"stale={stale}]",
+                sequences={
+                    rank: recovery_plan(
+                        is_root=(rank == 0), action=action, stale=stale
+                    )
+                    for rank in range(4)
+                },
+            )
+
+
+register_census_provider(supervisor_plan_censuses)
+
+
 def host_plan_findings(ctx: Context) -> list[Finding]:
     out = []
     for provider in list(CENSUS_PROVIDERS):

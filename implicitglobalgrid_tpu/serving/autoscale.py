@@ -168,8 +168,12 @@ class Autoscaler:
         }
 
     def status(self) -> dict:
+        # the incarnation's generation token rides the status view so a
+        # supervisor (or /v1/status reader) can attribute a resize verdict
+        # to the incarnation that produced it (docs/robustness.md)
         return {
             "rung": self.rung,
+            "generation": _config.generation_env(),
             "nproc": self.current.nproc,
             "capacity": self.current.capacity,
             "ladder": [

@@ -97,6 +97,14 @@ RESIZE_STATUS = 19
 #: the resize plan file rank 0 publishes into the checkpoint directory
 RESIZE_PLAN = "resize.json"
 
+#: request-body bound in bytes (``IGG_SERVE_MAX_BODY`` overrides): a POST
+#: past it is refused with a structured 413 before the handler buffers it
+MAX_BODY_DEFAULT = 1 << 20
+
+#: per-connection socket timeout in seconds — a slow-loris client times
+#: out and drops instead of pinning a rank-0 handler thread forever
+SOCKET_TIMEOUT_S = 10
+
 #: padding quantum of the control broadcast (bounds the compile cache)
 _BCAST_PAD = 1024
 
@@ -220,6 +228,7 @@ def broadcast_control(doc: dict | None) -> dict:
 def _make_handler(fd: "FrontDoor"):
     class _Handler(http.server.BaseHTTPRequestHandler):
         server_version = "igg-frontdoor/1"
+        timeout = SOCKET_TIMEOUT_S
 
         def _reply(self, code: int, body: dict, headers: dict | None = None,
                    raw: bytes | None = None, ctype: str = "application/json"):
@@ -263,8 +272,71 @@ def _make_handler(fd: "FrontDoor"):
         def do_POST(self):  # noqa: N802
             path = self.path.split("?", 1)[0]
             try:
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
+                # Request hardening (docs/serving.md): a malformed length
+                # header or an oversize body is a cheap structured refusal,
+                # never a 500 and never an unbounded buffer.
+                raw_len = self.headers.get("Content-Length")
+                try:
+                    length = int(raw_len) if raw_len is not None else 0
+                except ValueError:
+                    self._reply(400, {
+                        "error": f"malformed Content-Length {raw_len!r}",
+                    })
+                    return
+                if length < 0:
+                    self._reply(400, {
+                        "error": f"negative Content-Length {length}",
+                    })
+                    return
+                max_body = _config.serve_max_body_env() or MAX_BODY_DEFAULT
+                if length > max_body:
+                    _telemetry.counter("frontdoor.oversize_total").inc()
+                    self._reply(413, {
+                        "error": "request body too large",
+                        "bytes": length,
+                        "max_bytes": max_body,
+                    })
+                    return
+                # Chunked read under a TOTAL wall-clock budget: the socket
+                # timeout alone only bounds per-recv idle time — a client
+                # trickling one byte per 9 s would reset it forever.  The
+                # budget bounds the whole body, so a slow-loris gets a
+                # structured 408 (best effort — it may be gone) and its
+                # connection dropped; never the generic 500.
+                body = b""
+                deadline = time.monotonic() + SOCKET_TIMEOUT_S
+                try:
+                    while len(body) < length:
+                        if time.monotonic() > deadline:
+                            raise TimeoutError
+                        # read1 = at most ONE underlying recv (a plain
+                        # read(n) would loop recv until n bytes, resetting
+                        # the socket timer per byte — the loris hole again)
+                        chunk = self.rfile.read1(
+                            min(64 << 10, length - len(body))
+                        )
+                        if not chunk:
+                            break  # client hung up: the truncated-body 400
+                        body += chunk
+                except TimeoutError:
+                    self._reply(408, {
+                        "error": (
+                            f"body read exceeded the {SOCKET_TIMEOUT_S}s "
+                            f"budget ({len(body)} of {length} declared "
+                            f"bytes arrived)"
+                        ),
+                    })
+                    self.close_connection = True
+                    return
+                if len(body) < length:
+                    # the client hung up mid-body: a truncated document
+                    self._reply(400, {
+                        "error": (
+                            f"truncated body: {len(body)} of {length} "
+                            f"declared bytes arrived"
+                        ),
+                    })
+                    return
                 if path == "/v1/submit":
                     try:
                         doc = json.loads(body.decode() or "{}")
@@ -367,7 +439,14 @@ class FrontDoor:
         self._thread.start()
         _telemetry.gauge("frontdoor.port").set(self.port)
         _telemetry.event("frontdoor.start", host=host, port=self.port)
+        from ..supervisor import generation as _generation
+
         directory = _config.telemetry_dir_env()
+        if _generation.fence_refused("frontdoor.endpoint"):
+            # a superseded incarnation must not steal the discovery file
+            # from the door that replaced it (advisory path: refuse, the
+            # fence.rejected event is already on the timeline)
+            directory = None
         if directory:
             pub_host = socket.gethostname() if host in ("0.0.0.0", "::") else host
             doc = {"rank": self.rank, "pid": os.getpid(), "host": pub_host,
@@ -583,6 +662,15 @@ class FrontDoor:
             doc.update(resize)
         if self._shutdown:
             doc["shutdown"] = True
+        if doc:
+            # thread the incarnation's generation token through the
+            # control plane (docs/robustness.md): receivers verify it in
+            # `_apply` — a directive from another incarnation is refused
+            from ..supervisor import generation as _generation
+
+            gen = _generation.current_generation()
+            if gen is not None:
+                doc["gen"] = gen
         return doc or None
 
     def _maybe_autoscale(self) -> dict | None:
@@ -623,7 +711,23 @@ class FrontDoor:
 
     def _apply(self, msg: dict) -> str | None:
         """Every rank: apply one control message in a fixed order
-        (admissions → drain → resize → shutdown)."""
+        (admissions → drain → resize → shutdown).  A message stamped with
+        a DIFFERENT generation than this incarnation's is refused whole —
+        rank-uniformly (every rank of one incarnation carries the same
+        token and reads the same stamp), so the refusal can never split
+        the collectives a directive implies (`supervisor.policy.
+        recovery_plan` is the censused statement of that contract)."""
+        from ..supervisor import generation as _generation
+
+        gen = _generation.current_generation()
+        msg_gen = msg.get("gen")
+        if gen is not None and msg_gen is not None and msg_gen != gen:
+            _telemetry.counter("fence.rejected_total").inc()
+            _telemetry.event(
+                "fence.rejected", what="frontdoor.control",
+                generation=gen, authoritative=msg_gen,
+            )
+            return None
         for spec in msg.get("admit", []):
             self._admit_spec(spec)
         if "drain" in msg:
@@ -757,8 +861,16 @@ class FrontDoor:
         (rank 0, atomically), stop the HTTP server.  The caller exits with
         `RESIZE_STATUS`; the supervisor relaunches at ``plan``'s topology
         and the new process runs `elastic_resume`."""
+        from ..supervisor import generation as _generation
         from ..utils import checkpoint as _checkpoint
 
+        # Generation fence: a zombie incarnation publishing a resize plan
+        # would steer the supervisor at a topology the LIVE incarnation
+        # never asked for — the split-brain hole fencing closes.  Checked
+        # before the checkpoint too (save_checkpoint re-checks; this names
+        # the resize in the refusal).  Rank-uniform, so the raise cannot
+        # split the collective save.
+        _generation.check_fence("frontdoor.resize")
         with _tracing.trace_span("igg.frontdoor.resize",
                                  nproc=plan.get("nproc"),
                                  capacity=plan.get("capacity")):

@@ -1,0 +1,72 @@
+"""igg.supervisor — the self-healing run supervisor (docs/robustness.md).
+
+The reference's contract is "crash one node and the job is lost"; this
+subsystem is the opposite: a failure-domain manager that launches and OWNS
+a multi-process run end to end, in four pieces forming the state machine
+**detect → classify → policy → fence**:
+
+* `generation` — monotonically-increasing generation tokens per
+  incarnation, threaded through checkpoint meta / telemetry event tags /
+  front-door control broadcasts, with **fencing** at every durable publish
+  path (a zombie rank from a superseded generation is refused at
+  `save_checkpoint`, the ``resize.json`` publish and the endpoint-file
+  writes, and the refusal lands as a rank-tagged ``fence.rejected``
+  event).
+* `classify` — pure evidence → failure class: crash, step stall,
+  straggler, corrupt checkpoint, guard trip, gather tripwire, resize.
+* `policy` — pure incident → action: restart-in-place with
+  `backoff_schedule` semantics, elastic shrink after
+  ``IGG_SUPERVISE_MAX_RESTARTS`` strikes, scale-up reattempt when spares
+  return, permanent quarantine of ranks that keep failing integrity; plus
+  `recovery_plan`, the per-rank in-band collective schedule the
+  ``collective-consistency`` analyzer censuses.
+* `manager` — `RunSupervisor`, the orchestration loop: spawn, watch
+  (process liveness + liveplane ``/healthz`` scrapes), ingest flight
+  bundles and latched alerts, decide, fence, relaunch.  The soak
+  ``elastic_failover``/``frontdoor``/``chaos`` drills are thin wrappers
+  over it (`scripts/soak.py`).
+
+Host-side only: this package never imports jax — it must keep working
+while the fabric it supervises is wedged.
+"""
+
+from .classify import FAILURE_KINDS, Incident, classify, collect_evidence
+from .generation import (
+    FenceError,
+    authoritative_generation,
+    check_fence,
+    current_generation,
+    fence_refused,
+    publish_generation,
+)
+from .manager import Incarnation, RunSupervisor, SupervisorReport
+from .policy import (
+    ACTIONS,
+    Decision,
+    RecoveryPolicy,
+    SupervisorState,
+    decide,
+    recovery_plan,
+)
+
+__all__ = [
+    "FAILURE_KINDS",
+    "ACTIONS",
+    "Incident",
+    "classify",
+    "collect_evidence",
+    "FenceError",
+    "current_generation",
+    "authoritative_generation",
+    "publish_generation",
+    "check_fence",
+    "fence_refused",
+    "Decision",
+    "RecoveryPolicy",
+    "SupervisorState",
+    "decide",
+    "recovery_plan",
+    "Incarnation",
+    "RunSupervisor",
+    "SupervisorReport",
+]

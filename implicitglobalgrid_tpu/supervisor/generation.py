@@ -1,0 +1,155 @@
+"""Generation tokens + fencing: the split-brain closure of the supervisor.
+
+A supervised run is a sequence of *incarnations*: every (re)launch gets a
+monotonically-increasing **generation token** (``IGG_GENERATION``, set by
+`RunSupervisor` identically on every rank of the incarnation) and the
+supervisor publishes the authoritative current token atomically as
+``generation.json`` under the fence directory (``IGG_FENCE_DIR``, normally
+the run's checkpoint/work directory).  The token is threaded through
+
+* checkpoint meta (`utils.checkpoint.save_checkpoint` records it),
+* telemetry event tags (every event line carries ``gen`` when set), and
+* front-door control broadcasts (`serving.frontdoor` stamps and verifies).
+
+**Fencing.**  A zombie rank — a process from a superseded incarnation that
+a kill signal missed, or that woke from a stall after its replacement
+launched — still believes it owns the run.  Every durable *publish* path
+therefore checks the fence first: a process whose ``IGG_GENERATION`` is
+older than the authoritative token is **refused** (`FenceError`), and the
+refusal lands as a rank-tagged ``fence.rejected`` telemetry event plus the
+``fence.rejected_total`` counter.  Fenced paths: `save_checkpoint`, the
+front door's ``resize.json`` publish, and the liveplane/front-door
+endpoint-file writes (advisory files: refused silently-but-evented via
+`fence_refused` instead of raising out of a daemon thread).
+
+The check is deliberately rank-uniform: every rank of one incarnation
+carries the same token and reads the same fence file, so a fence decision
+can never split an SPMD collective (the deadlock class
+``analysis.collectives`` pins; see `supervisor.policy.recovery_plan`).
+Unfenced runs (``IGG_GENERATION`` unset, the default) skip every check —
+fencing is an opt-in contract between a supervisor and its children.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..utils import config as _config
+from ..utils import telemetry as _telemetry
+
+__all__ = [
+    "FenceError",
+    "GENERATION_FILE",
+    "current_generation",
+    "authoritative_generation",
+    "publish_generation",
+    "fence_refusal",
+    "fence_refused",
+    "check_fence",
+]
+
+#: the authoritative-token file the supervisor publishes under IGG_FENCE_DIR
+GENERATION_FILE = "generation.json"
+
+
+class FenceError(RuntimeError):
+    """A write was refused because this process's generation is superseded."""
+
+    def __init__(self, message: str, *, generation: int, authoritative: int):
+        super().__init__(message)
+        self.generation = generation
+        self.authoritative = authoritative
+
+
+def current_generation() -> int | None:
+    """This incarnation's token (``IGG_GENERATION``; None = unfenced)."""
+    return _config.generation_env()
+
+
+def fence_dir() -> str | None:
+    """Where the authoritative token lives (``IGG_FENCE_DIR``)."""
+    return _config.fence_dir_env()
+
+
+def authoritative_generation(directory: str | None = None) -> int | None:
+    """The supervisor-published current token, or None when no fence file
+    is readable (no supervisor, or a pre-fencing run directory)."""
+    directory = directory if directory is not None else fence_dir()
+    if not directory:
+        return None
+    try:
+        with open(os.path.join(directory, GENERATION_FILE)) as f:
+            doc = json.load(f)
+        return int(doc["generation"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def publish_generation(generation: int, directory: str | None = None,
+                       **info) -> str:
+    """Supervisor-side: atomically publish ``generation`` as the
+    authoritative token (refuses to move the token backwards — the
+    monotonicity that makes stale-token refusal sound)."""
+    directory = directory if directory is not None else fence_dir()
+    if not directory:
+        raise ValueError(
+            "publish_generation needs a fence directory (argument or "
+            "IGG_FENCE_DIR)."
+        )
+    generation = int(generation)
+    prev = authoritative_generation(directory)
+    if prev is not None and generation < prev:
+        raise ValueError(
+            f"generation must be monotonic: refusing to publish "
+            f"{generation} over the authoritative {prev}."
+        )
+    os.makedirs(directory, exist_ok=True)
+    return _telemetry.atomic_write_json(
+        os.path.join(directory, GENERATION_FILE),
+        {"generation": generation, "ts": time.time(), **info},
+    )
+
+
+def fence_refusal(what: str) -> FenceError | None:
+    """The fence decision for one publish attempt, WITHOUT raising.
+
+    Returns a `FenceError` (already evented: one rank-tagged
+    ``fence.rejected`` line + the ``fence.rejected_total`` counter) when
+    this process carries a stale token, else None.  Rank-uniform by
+    construction: the token is per-incarnation env state and the
+    authoritative file is shared, so every rank of one incarnation reaches
+    the same verdict.
+    """
+    gen = current_generation()
+    if gen is None:
+        return None
+    auth = authoritative_generation()
+    if auth is None or auth <= gen:
+        return None
+    _telemetry.counter("fence.rejected_total").inc()
+    _telemetry.event(
+        "fence.rejected", what=what, generation=gen, authoritative=auth
+    )
+    return FenceError(
+        f"{what} refused: this process carries generation {gen} but the "
+        f"supervisor has moved the run to generation {auth} — a superseded "
+        f"(zombie) incarnation must not publish state.",
+        generation=gen,
+        authoritative=auth,
+    )
+
+
+def fence_refused(what: str) -> bool:
+    """Non-raising fence check for advisory writes (endpoint files): True
+    = refuse (the refusal is already evented)."""
+    return fence_refusal(what) is not None
+
+
+def check_fence(what: str) -> None:
+    """Raising fence check for durable publishes (checkpoints, resize
+    plans): raises the evented `FenceError` when superseded."""
+    err = fence_refusal(what)
+    if err is not None:
+        raise err

@@ -60,6 +60,7 @@ import numpy as np
 
 from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES
+from . import config as _config
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 
@@ -156,6 +157,15 @@ def _save_checkpoint(
     """
     import jax
 
+    # Generation fencing (docs/robustness.md): a rank from a superseded
+    # incarnation must never publish state.  Checked BEFORE any byte lands
+    # on disk; the verdict is rank-uniform (per-incarnation env token vs
+    # the shared fence file), so the refusal cannot split the collective
+    # save below.  Function-level import: utils must not pull the
+    # supervisor package at module load.
+    from ..supervisor import generation as _generation
+
+    _generation.check_fence("checkpoint.save")
     _grid.check_initialized()
     gg = _grid.global_grid()
     state = tuple(state)
@@ -244,6 +254,13 @@ def _save_checkpoint(
             "shards": shards,
             "extra": extra or {},
         }
+        # The writing incarnation's generation token (docs/robustness.md):
+        # lets a supervisor attribute every generation on disk to the
+        # incarnation that produced it.  Absent on unfenced runs — the
+        # format is unchanged, the key is additive.
+        gen = _config.generation_env()
+        if gen is not None:
+            meta["generation"] = gen
         tmp = os.path.join(tmp_dir, _META + ".tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
@@ -362,13 +379,25 @@ def latest_checkpoint(
     interval, not poison it.  ``verify=False`` restores the cheap
     marker-only scan (format-1 semantics) for callers that only need the
     newest published path.
+
+    Every verifying walk publishes the ``checkpoint.fallback_depth`` gauge
+    (generations skipped before the winner — 0 on a healthy pick), so the
+    supervisor and ``igg_top`` can tell a healthy restart from one limping
+    on old state without replaying the event log.
     """
+    skipped = 0
     for step, path in reversed(checkpoint_steps(directory)):
         if not verify:
             return path
         problem = verify_checkpoint(path)
         if problem is None:
+            _telemetry.gauge("checkpoint.fallback_depth").set(skipped)
+            if skipped:
+                _telemetry.event(
+                    "checkpoint.fallback_depth", depth=skipped, path=path
+                )
             return path
+        skipped += 1
         _telemetry.event("checkpoint.fallback", path=path, problem=problem)
         _telemetry.counter("checkpoint.fallbacks").inc()
         print(

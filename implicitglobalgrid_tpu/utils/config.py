@@ -154,6 +154,34 @@ The *mechanism* carries over with the TPU-meaningful knobs:
 ``IGG_AUTOSCALE_SUSTAIN`` consecutive autoscaler observations (int >= 1,
                           default 2) a non-``hold`` verdict must sustain
                           before a resize commits
+``IGG_SERVE_MAX_BODY``    front-door request-body bound in bytes (int >= 1,
+                          default 1 MiB = 1048576): a ``POST`` whose body
+                          (declared or actual) exceeds it is refused with
+                          a structured 413 before the handler buffers it —
+                          the slow-loris/oversize hardening of
+                          `serving.frontdoor` (docs/serving.md)
+``IGG_GENERATION``        this incarnation's generation token (int >= 0;
+                          unset = unfenced).  Set by the run supervisor
+                          identically on every rank of one incarnation;
+                          threaded through checkpoint meta, telemetry
+                          event tags and front-door control broadcasts,
+                          and checked against the authoritative fence file
+                          at every durable publish
+                          (`supervisor.generation`, docs/robustness.md)
+``IGG_FENCE_DIR``         directory of the supervisor-published
+                          authoritative ``generation.json`` fence file
+                          (unset = no fence checks) — read per publish,
+                          like the other resilience knobs
+``IGG_SUPERVISE_MAX_RESTARTS``  in-place restarts per continuous failure
+                          streak before the supervisor's policy engine
+                          drops a topology rung (int >= 0, default 2;
+                          `supervisor.policy.RecoveryPolicy`)
+``IGG_SUPERVISE_BACKOFF_S``  base of the supervisor's exponential relaunch
+                          backoff in seconds (number > 0, default 0.5;
+                          `utils.resilience.backoff_schedule` semantics)
+``IGG_SUPERVISE_POLL_S``  supervisor liveness/health polling cadence in
+                          seconds (number > 0, default 0.5;
+                          `supervisor.manager.RunSupervisor`)
 ``IGG_AUTOTUNE``          default for the models' ``make_multi_step``
                           ``autotune=`` kwarg (``implicitglobalgrid_tpu.
                           tuning``; nonzero = on, unset/0 = off): on first
@@ -524,6 +552,46 @@ def autoscale_sustain_env() -> int | None:
     """``IGG_AUTOSCALE_SUSTAIN``: consecutive non-hold autoscaler verdicts
     before a resize commits (>= 1, default 2)."""
     return _int_env("IGG_AUTOSCALE_SUSTAIN", minimum=1)
+
+
+def serve_max_body_env() -> int | None:
+    """``IGG_SERVE_MAX_BODY``: front-door request-body bound in bytes
+    (>= 1; unset = the 1 MiB default, `serving.frontdoor.MAX_BODY_DEFAULT`)."""
+    return _int_env("IGG_SERVE_MAX_BODY", minimum=1)
+
+
+# -- Supervisor / generation-fencing knobs (docs/robustness.md) ---------------
+
+
+def generation_env() -> int | None:
+    """``IGG_GENERATION``: this incarnation's generation token (>= 0;
+    None = unfenced — the default outside a supervised run)."""
+    return _int_env("IGG_GENERATION", minimum=0)
+
+
+def fence_dir_env() -> str | None:
+    """``IGG_FENCE_DIR``: directory of the authoritative ``generation.json``
+    fence file (unset = fence checks off)."""
+    val = os.environ.get("IGG_FENCE_DIR")
+    return val or None
+
+
+def supervise_max_restarts_env() -> int | None:
+    """``IGG_SUPERVISE_MAX_RESTARTS``: in-place restarts per failure streak
+    before the supervisor shrinks a rung (>= 0, default 2)."""
+    return _int_env("IGG_SUPERVISE_MAX_RESTARTS", minimum=0)
+
+
+def supervise_backoff_env() -> float | None:
+    """``IGG_SUPERVISE_BACKOFF_S``: base relaunch backoff in seconds
+    (> 0, default 0.5)."""
+    return _float_env("IGG_SUPERVISE_BACKOFF_S", exclusive_minimum=0)
+
+
+def supervise_poll_env() -> float | None:
+    """``IGG_SUPERVISE_POLL_S``: supervisor liveness/health polling cadence
+    in seconds (> 0, default 0.5)."""
+    return _float_env("IGG_SUPERVISE_POLL_S", exclusive_minimum=0)
 
 
 # -- Autotuning knobs (read per resolve, host-side; docs/performance.md) ------

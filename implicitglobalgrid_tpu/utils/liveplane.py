@@ -729,6 +729,10 @@ def endpoint_filename(rank: int) -> str:
 
 class _Handler(http.server.BaseHTTPRequestHandler):
     server_version = "igg-liveplane/1"
+    #: per-connection socket timeout: a stalled scraper drops its
+    #: connection instead of pinning a handler thread (the front door's
+    #: slow-loris hardening, applied to the scrape plane too)
+    timeout = 10
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path = self.path.split("?", 1)[0]
@@ -808,6 +812,16 @@ def _publish_endpoint(server: MetricsServer) -> None:
     _telemetry.gauge("liveplane.port").set(server.port)
     directory = _config.telemetry_dir_env()
     if not directory:
+        return
+    # Generation fence (docs/robustness.md): a zombie incarnation must not
+    # overwrite the live one's discovery file — igg_top would scrape the
+    # dead rank.  Advisory path: refuse (the fence.rejected event is
+    # already on the timeline) instead of raising out of the server
+    # bring-up.  Function-level import: utils stays supervisor-free at
+    # module load.
+    from ..supervisor import generation as _generation
+
+    if _generation.fence_refused("liveplane.endpoint"):
         return
     rank = _telemetry._proc_index()
     _published_rank = rank

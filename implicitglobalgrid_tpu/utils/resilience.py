@@ -20,11 +20,15 @@ production stack ships first:
   policy inside the models' time loops (``guard_every=N``).
 * **Fault injection** — `FaultInjector` parses ``IGG_FAULT_INJECT``
   (``init_flake:N``, ``halo_corrupt:stepN[:blockB]``,
-  ``worker_crash:stepN[:procP]``, ``ckpt_corrupt:stepN[:shardS]``,
+  ``worker_crash:stepN[:procP]``, ``stall:stepN[:procP]``,
+  ``net_delay:stepN[:procP]``, ``ckpt_corrupt:stepN[:shardS]``,
   ``ckpt_truncate:stepN[:shardS]``; several compose comma-separated via
-  `FaultSet`) so the 2-process `test_distributed.py` path and
+  `FaultSet`, and ``chaos:seed=N:rate=R[:steps=M][:kinds=a+b]`` expands
+  into a deterministic randomized storm over those kinds —
+  `chaos_schedule`) so the 2-process `test_distributed.py` path and
   `scripts/soak.py` can prove crash→restart-from-checkpoint,
-  corruption→guard-trip and damaged-generation fallback end to end.
+  corruption→guard-trip, damaged-generation fallback and the supervised
+  multi-fault ``chaos`` drill end to end.
 
 Checkpoint/restart itself lives in `utils.checkpoint`; `RunGuard` drives it.
 """
@@ -62,6 +66,9 @@ __all__ = [
     "get_fault_injector",
     "reset_fault_injector",
     "snapshot_state",
+    "chaos_schedule",
+    "expand_fault_spec",
+    "fault_event_matches_spec",
 ]
 
 
@@ -447,6 +454,7 @@ FAULT_KINDS = (
     "halo_corrupt",
     "worker_crash",
     "stall",
+    "net_delay",
     "ckpt_corrupt",
     "ckpt_truncate",
 )
@@ -456,9 +464,128 @@ _TARGET_PREFIX = {
     "halo_corrupt": "block",
     "worker_crash": "proc",
     "stall": "proc",
+    "net_delay": "proc",
     "ckpt_corrupt": "shard",
     "ckpt_truncate": "shard",
 }
+
+#: kinds the seeded chaos schedule samples from (init_flake excluded: it
+#: fires during bring-up, outside the per-step storm the schedule models)
+CHAOS_KINDS = (
+    "worker_crash",
+    "stall",
+    "net_delay",
+    "ckpt_corrupt",
+    "ckpt_truncate",
+    "halo_corrupt",
+)
+
+#: chaos-mode defaults (spec grammar: ``chaos:seed=N:rate=R[:steps=M][:kinds=a+b]``)
+CHAOS_STEPS_DEFAULT = 16
+
+
+def chaos_schedule(
+    seed: int,
+    rate: float,
+    *,
+    steps: int = CHAOS_STEPS_DEFAULT,
+    kinds: Sequence[str] = CHAOS_KINDS,
+) -> list[str]:
+    """The deterministic randomized fault storm of one chaos spec.
+
+    Samples at most ONE fault per time-loop step (unambiguous
+    ``(kind, step)`` identity — what lets a supervisor match ``fault.*``
+    events back to the armed schedule and prune fired faults across
+    relaunches): for each step ``1..steps``, with probability ``rate`` a
+    kind is drawn uniformly from ``kinds``.  Pure function of its
+    arguments (`random.Random(seed)`), so the supervisor, the soak driver
+    and a test all derive the identical storm from the spec alone.
+    Targets stay at each kind's default (crash/stall/delay: the last
+    process; ckpt damage: shard 0; corruption: block 0).
+    """
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"chaos rate must be in [0, 1] (got {rate})")
+    if steps < 1:
+        raise ValueError(f"chaos steps must be >= 1 (got {steps})")
+    bad = [k for k in kinds if k not in FAULT_KINDS or k == "init_flake"]
+    if bad:
+        raise ValueError(
+            f"chaos kinds {bad} not samplable; choose from {CHAOS_KINDS}"
+        )
+    rng = random.Random(seed)
+    out = []
+    for step in range(1, steps + 1):
+        if rng.random() < rate:
+            out.append(f"{rng.choice(list(kinds))}:step{step}")
+    return out
+
+
+def _parse_chaos_spec(spec: str) -> list[str]:
+    """``chaos:seed=N:rate=R[:steps=M][:kinds=a+b]`` -> concrete specs."""
+    fields: dict[str, str] = {}
+    for part in spec.split(":")[1:]:
+        key, sep, val = part.partition("=")
+        if not sep or key not in ("seed", "rate", "steps", "kinds"):
+            raise ValueError(
+                f"IGG_FAULT_INJECT: {spec!r} — chaos takes "
+                f"'chaos:seed=N:rate=R[:steps=M][:kinds=a+b]' "
+                f"(got component {part!r})."
+            )
+        fields[key] = val
+    try:
+        seed = int(fields["seed"])
+        rate = float(fields["rate"])
+        steps = int(fields.get("steps", CHAOS_STEPS_DEFAULT))
+    except (KeyError, ValueError):
+        raise ValueError(
+            f"IGG_FAULT_INJECT: {spec!r} — chaos needs integer seed=, "
+            f"decimal rate= (and optional integer steps=)."
+        )
+    kinds = (
+        tuple(fields["kinds"].split("+")) if "kinds" in fields else CHAOS_KINDS
+    )
+    return chaos_schedule(seed, rate, steps=steps, kinds=kinds)
+
+
+def expand_fault_spec(spec: str | None) -> list[str]:
+    """A comma-separated ``IGG_FAULT_INJECT`` value as CONCRETE per-fault
+    specs, ``chaos:`` parts expanded through `chaos_schedule` — the form a
+    supervisor arms, prunes (`fault_event_matches_spec`) and re-arms."""
+    if not spec:
+        return []
+    out: list[str] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith("chaos:") or part == "chaos":
+            out.extend(_parse_chaos_spec(part))
+        else:
+            FaultInjector.from_spec(part)  # validate eagerly
+            out.append(part)
+    return out
+
+
+def fault_event_matches_spec(events: Sequence[dict], spec: str) -> bool:
+    """Did one of these ``fault.*`` event records fire THIS concrete spec?
+
+    The supervisor's cross-incarnation fire-once hygiene: a fault whose
+    event is on the timeline is pruned from the next incarnation's
+    environment (a crash at step N must not re-crash the restart that
+    resumes from the step-N checkpoint).  Identity is ``(kind, step)``
+    (`chaos_schedule` guarantees uniqueness); ``init_flake`` matches on
+    any firing.
+    """
+    inj = FaultInjector.from_spec(spec)
+    etype = f"fault.{inj.kind}"
+    for e in events:
+        if e.get("type") != etype:
+            continue
+        if inj.kind == "init_flake":
+            return True
+        if e.get("step") == inj.step:
+            return True
+    return False
 
 
 @dataclasses.dataclass
@@ -487,6 +614,13 @@ class FaultInjector:
       scrape-time step-stall rule (`utils.liveplane.StepStallRule`) exists
       to see from outside the loop; the soak ``live_plane`` scenario
       drives this end to end.
+    * ``net_delay:stepN[:procP]`` — after time-loop step ``N``, process
+      ``P`` (default: the last process) arms `NET_DELAY_S` seconds of
+      latency on its NEXT host control collective (the skew-probe /
+      ``broadcast_control`` transport, `utils.tracing.
+      arm_collective_delay`): the rank enters the collective late and its
+      peers block with it — a transient network fault that recovers on
+      its own (the chaos storm's benign kind).
     * ``ckpt_corrupt:stepN[:shardS]`` — right after the step-``N`` checkpoint
       publishes, a byte of shard file ``S`` (default 0) is flipped WITHOUT
       updating the manifest (process 0 applies it).  Proves the CRC
@@ -512,6 +646,21 @@ class FaultInjector:
 
     #: injected-stall duration in seconds (class attr: tests shrink it)
     STALL_S = 6.0
+
+    #: injected host-collective latency in seconds (class attr: tests shrink)
+    NET_DELAY_S = 1.5
+
+    def spec(self) -> str:
+        """The canonical spec string this injector parses back from (the
+        supervisor's arm/prune round-trip)."""
+        if self.kind is None:
+            return ""
+        if self.kind == "init_flake":
+            return f"init_flake:{self.count}"
+        out = f"{self.kind}:step{self.step}"
+        if self.target is not None:
+            out += f":{_TARGET_PREFIX[self.kind]}{self.target}"
+        return out
 
     @classmethod
     def from_spec(cls, spec: str | None) -> "FaultInjector":
@@ -608,6 +757,7 @@ class FaultInjector:
             "fault.halo_corrupt",
             index=list(int(i) for i in idx),
             block=self.target or 0,
+            step=announce_step if announce_step is not None else self.step,
         )
         if _safe_process_index() == 0:
             at = "" if announce_step is None else f" after step {announce_step}"
@@ -686,6 +836,33 @@ class FaultInjector:
         )
         time.sleep(self.STALL_S)
 
+    # - net_delay -
+
+    def maybe_net_delay(self, step: int) -> None:
+        """After step ``step``: arm `NET_DELAY_S` of latency on the target
+        process's NEXT host control collective (`utils.tracing.
+        arm_collective_delay` — the skew-probe / `broadcast_control`
+        transport).  A transient network fault, not a hang: the delayed
+        rank enters the collective late, its peers block with it, and the
+        skew probe sees the straggle — nothing needs supervisor
+        intervention, which is exactly what a chaos storm needs between
+        the faults that do."""
+        if self.kind != "net_delay" or self.fired or step != self.step:
+            return
+        want = self.target if self.target is not None else _last_process_index()
+        if _safe_process_index() != want:
+            return
+        self.fired = True
+        _telemetry.event("fault.net_delay", step=step, delay_s=self.NET_DELAY_S)
+        print(
+            f"[igg.resilience] IGG_FAULT_INJECT(net_delay): delaying the "
+            f"next host control collective by {self.NET_DELAY_S}s "
+            f"(after step {step})",
+            file=sys.stderr,
+            flush=True,
+        )
+        _tracing.arm_collective_delay(self.NET_DELAY_S)
+
     # - ckpt_corrupt / ckpt_truncate -
 
     def maybe_damage_checkpoint(self, step_dir: str, step: int) -> None:
@@ -744,13 +921,12 @@ class FaultSet:
 
     @classmethod
     def from_spec(cls, spec: str | None) -> "FaultSet":
-        if not spec:
-            return cls()
+        """Parse a comma-separated spec; ``chaos:seed=N:rate=R[...]`` parts
+        expand into their deterministic storm (`chaos_schedule`) first."""
         return cls(
             tuple(
-                FaultInjector.from_spec(part.strip())
-                for part in spec.split(",")
-                if part.strip()
+                FaultInjector.from_spec(part)
+                for part in expand_fault_spec(spec)
             )
         )
 
@@ -779,6 +955,14 @@ class FaultSet:
     def maybe_stall(self, step: int) -> None:
         for i in self.injectors:
             i.maybe_stall(step)
+
+    def maybe_net_delay(self, step: int) -> None:
+        for i in self.injectors:
+            i.maybe_net_delay(step)
+
+    def specs(self) -> list[str]:
+        """Canonical per-fault spec strings (the supervisor round-trip)."""
+        return [i.spec() for i in self.injectors if i.active]
 
     def maybe_damage_checkpoint(self, step_dir: str, step: int) -> None:
         for i in self.injectors:
@@ -1151,6 +1335,7 @@ class RunGuard:
                 )
         self._injector.maybe_crash(it)
         self._injector.maybe_stall(it)
+        self._injector.maybe_net_delay(it)
         return state, it
 
     def _trip(self, state: tuple, it: int, report: FieldReport) -> tuple:

@@ -610,6 +610,13 @@ def event(etype: str, **payload: Any) -> None:
         "pid": os.getpid(),
         "coords": _grid_coords(),
     }
+    # Supervised runs thread the incarnation's generation token through
+    # every event line (docs/robustness.md): a post-mortem timeline from a
+    # shared directory attributes each event to its incarnation, and a
+    # zombie's late writes are visibly stale.  Absent when unfenced.
+    gen = _config.generation_env()
+    if gen is not None:
+        rec["gen"] = gen
     rec.update(payload)
     try:
         line = json.dumps(rec, default=str) + "\n"

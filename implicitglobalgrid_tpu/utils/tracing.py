@@ -78,6 +78,7 @@ __all__ = [
     "merge_trace_files",
     "validate_chrome_trace",
     "skew_probe",
+    "arm_collective_delay",
     "dump_flight_recorder",
     "reset",
 ]
@@ -617,6 +618,28 @@ def _skew_fn(gg):
     return fn
 
 
+#: one-shot latency armed on the next host control collective (the
+#: ``net_delay`` fault kind, `utils.resilience`): seconds slept before this
+#: process dispatches into `all_ranks_value` — its peers block with it,
+#: which is exactly the transient network fault the chaos plane models.
+_collective_delay = 0.0
+
+
+def arm_collective_delay(seconds: float) -> None:
+    """Arm one-shot latency on this process's next host control collective
+    (consumed by `all_ranks_value` — the skew-probe / `broadcast_control`
+    transport).  The fault-injection hook of ``net_delay``."""
+    global _collective_delay
+    _collective_delay = max(0.0, float(seconds))
+
+
+def _consume_collective_delay() -> None:
+    global _collective_delay
+    delay, _collective_delay = _collective_delay, 0.0
+    if delay:
+        time.sleep(delay)
+
+
 def all_ranks_value(value: float):
     """Share one host scalar per process with every process.
 
@@ -631,6 +654,7 @@ def all_ranks_value(value: float):
 
     if not _grid.grid_is_initialized() or jax.process_count() == 1:
         return None
+    _consume_collective_delay()
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -787,10 +811,11 @@ def read_flight_bundles(path: str | os.PathLike) -> list[dict]:
 def reset() -> None:
     """Drop the span ring, open stacks, clock sync and probe caches
     (test hook)."""
-    global _ring, _ring_cap, _clock_sync
+    global _ring, _ring_cap, _clock_sync, _collective_delay
     with _lock:
         _ring = None
         _ring_cap = 0
     _open_stacks.clear()
     _clock_sync = None
+    _collective_delay = 0.0
     _skew_cache.clear()

@@ -84,10 +84,30 @@ telemetry snapshot instead of private tallies.
   rounds/s recorded (``frontdoor_soak.json``) — the same metric names
   ``bench.py``'s ``frontdoor_serving`` extra gates.
 
+* ``chaos`` — the self-healing drill (docs/robustness.md): a SEEDED
+  randomized multi-fault storm (``IGG_FAULT_INJECT=chaos:seed=N:rate=R``,
+  sampling crash + stall + ckpt_corrupt + net_delay) over a real
+  2-process gloo pair owned end to end by `igg.supervisor.RunSupervisor`.
+  The supervisor polls liveness + the per-rank liveplane ``/healthz``
+  endpoints, classifies every failure, restarts in place (one strike),
+  then shrinks elastically to 1 process once the strikes are spent —
+  pruning fired faults from each relaunch and fencing every superseded
+  generation.  Acceptance: both recovery legs exercised, the final
+  gathered dedup-space field BIT-IDENTICAL to an undisturbed oracle, and
+  the detect → classify → recover event ORDER verified from the per-rank
+  ``events.jsonl`` timeline.
+
+The ``elastic_failover``, ``frontdoor`` and ``chaos`` scenarios are thin
+wrappers over `igg.supervisor` — the spawn/watch/classify/relaunch logic
+lives in the package, the drills keep only their load generators and
+acceptance checks.
+
 ``--quick`` runs the ``elastic_failover`` drill, the ``serving`` smoke,
-the ``live_plane`` drill and the ``frontdoor`` drill at small size — the
-fast smoke path (registered next to the tier-1 command in
-docs/testing.md).
+the ``live_plane`` drill, the ``frontdoor`` drill and the ``chaos`` storm
+at small size — the fast smoke path (registered next to the tier-1
+command in docs/testing.md).  Scenarios can also be named positionally:
+``python scripts/soak.py chaos --quick`` runs just the chaos drill at
+quick sizing.
 """
 
 from __future__ import annotations
@@ -104,7 +124,8 @@ REPO = os.path.dirname(HERE)
 CRASH_STATUS = 17   # FaultInjector.CRASH_STATUS
 RESIZE_STATUS = 19  # serving.frontdoor.RESIZE_STATUS
 SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash",
-             "elastic_failover", "serving", "live_plane", "frontdoor")
+             "elastic_failover", "serving", "live_plane", "frontdoor",
+             "chaos")
 
 
 def _free_port() -> int:
@@ -534,17 +555,28 @@ class _DoorClient:
 
 
 def supervise_frontdoor(args) -> bool:
-    """The frontdoor drill supervisor (module docstring): three phases
-    across two elastic resizes, with the load generator, the stall-driven
-    backpressure check and the digest acceptance in one pass."""
+    """The frontdoor drill (module docstring): three phases across two
+    elastic resizes — now a thin wrapper over
+    `igg.supervisor.RunSupervisor`: the subsystem owns spawn/liveness/
+    resize-plan handling/relaunch (a ``resize`` classification maps onto
+    the ladder through ``on_resize``), while this wrapper keeps only the
+    drill-specific load generator, the stall-driven backpressure check and
+    the digest acceptance, injected per incarnation via the ``drive``
+    hook."""
     import json as _json
     import shutil
     import time as _time
 
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu import supervisor as sup
+
     workdir = args.workdir
     ckpt = os.path.join(workdir, "ckpt_frontdoor")
+    run_dir = os.path.join(workdir, "frontdoor_run")
     tele_dir = os.path.join(workdir, "telemetry_frontdoor")
     shutil.rmtree(ckpt, ignore_errors=True)
+    shutil.rmtree(run_dir, ignore_errors=True)
     shutil.rmtree(tele_dir, ignore_errors=True)
     steps = max(4, args.steps)
     cap1, cap2 = 2, 4
@@ -577,26 +609,45 @@ def supervise_frontdoor(args) -> bool:
     with open(oracle_out) as f:
         oracle = _json.load(f)
 
-    def _cmd(phase):
-        return [
-            sys.executable, os.path.abspath(__file__), "--frontdoor-child",
-            "--nx", str(args.nx), "--steps", str(steps),
-            "--nproc", str(phase["nproc"]), "--pair-id", "PID",
-            "--port", str(phase["port"]), "--ckpt-dir", ckpt,
-            "--capacity", str(phase["capacity"]), "--rung", str(phase["rung"]),
-            "--resume", str(int(phase["resume"])), "--ladder", ladder,
-            "--timeout", str(args.timeout),
-        ]
-
     endpoint_file = os.path.join(tele_dir, "frontdoor.p0.json")
     accepted: dict[str, dict] = {}  # rid -> {tenant, ic, ms, t}
     done: dict[str, dict] = {}
     to_submit: list[tuple] = []     # load not yet 202-accepted; survives
-    phase_log: list[dict] = []      # phase transitions (a resize may land
+    resize_plans: list[dict] = []   # phase transitions (a resize may land
     slo_429 = None                  # mid-burst — leftovers hit the next door)
     slo_metrics_seen = False
     shutdown_sent = False
-    logs_to_dump: list[str] = []
+    final_status = None
+    # launch parameters the supervisor's command_for/on_resize drive: the
+    # autoscale rung/capacity ride the workload's own resize plans
+    fdstate = {"phase": 0, "capacity": cap1, "as_rung": 0, "resume": False,
+               "port": 0, "gen": None}
+
+    def command_for(rank, nranks, rung, gen):
+        if fdstate["gen"] != gen:
+            fdstate["gen"] = gen
+            fdstate["port"] = _free_port() if nranks > 1 else 0
+        return [
+            sys.executable, os.path.abspath(__file__), "--frontdoor-child",
+            "--nx", str(args.nx), "--steps", str(steps),
+            "--nproc", str(nranks), "--pair-id", str(rank),
+            "--port", str(fdstate["port"]), "--ckpt-dir", ckpt,
+            "--capacity", str(fdstate["capacity"]),
+            "--rung", str(fdstate["as_rung"]),
+            "--resume", str(int(fdstate["resume"])), "--ladder", ladder,
+            "--timeout", str(args.timeout),
+        ]
+
+    def on_resize(plan):
+        resize_plans.append({k: plan[k] for k in
+                             ("nproc", "capacity", "rung", "reason")
+                             if k in plan})
+        fdstate["capacity"] = int(plan["capacity"])
+        fdstate["as_rung"] = int(plan["rung"])
+        fdstate["resume"] = True
+        # manager ladder: rung 0 = the 2-process (preferred) topology,
+        # rung 1 = the 1-process one (the drill STARTS there)
+        return 0 if int(plan["nproc"]) == 2 else 1
 
     def _try_submit(client, tenant, ic, ms, phase_no) -> bool:
         """ONE submit attempt; True iff 202-accepted (429/unreachable =
@@ -626,72 +677,43 @@ def supervise_frontdoor(args) -> bool:
                 view["t_done"] = _time.monotonic()
                 done[rid] = view
 
-    phase = {"nproc": 1, "capacity": cap1, "rung": 0, "resume": False,
-             "port": 0}
-    phase_no = 0
-    final_status = None
     t_drill0 = _time.monotonic()
-    while True:
-        phase_no += 1
-        if phase_no > 6:
-            return _report("frontdoor", False,
-                           "more phases than the two expected resizes")
-        if phase["nproc"] > 1:
-            phase["port"] = _free_port()
-        try:
-            os.remove(endpoint_file)
-        except OSError:
-            pass
-        env_extra = {
-            "IGG_TELEMETRY": "1", "IGG_TELEMETRY_DIR": tele_dir,
-            "IGG_HEARTBEAT_EVERY": "1", "IGG_SERVE_PORT": "0",
-            "IGG_AUTOSCALE_QUEUE_HIGH": "3", "IGG_AUTOSCALE_SUSTAIN": "1",
-            "IGG_FRONTDOOR_QUEUE_MAX": "64",
-        }
-        if phase_no == 1:
-            # the SLO-breach leg: wedge the serving thread after round 1
-            env_extra["IGG_FAULT_INJECT"] = "stall:step1"
-        env = _elastic_env(env_extra)
-        logs = []
-        procs = []
-        for pid in range(phase["nproc"]):
-            log_path = os.path.join(workdir, f"frontdoor_p{phase_no}_{pid}.log")
-            logs.append(open(log_path, "w+"))
-            logs_to_dump.append(log_path)
-            cmd = [c if c != "PID" else str(pid) for c in _cmd(phase)]
-            procs.append(subprocess.Popen(
-                cmd, env=env, stdout=logs[-1], stderr=subprocess.STDOUT,
-                text=True,
-            ))
 
-        def _fail(detail):
-            for q in procs:
-                q.kill()
-            for path in logs_to_dump[-phase["nproc"]:]:
-                with open(path) as f:
-                    print(f.read(), file=sys.stderr)
-            for f in logs:
-                f.close()
-            return _report("frontdoor", False, f"phase {phase_no}: {detail}")
+    def drive(inc):
+        """One incarnation's client work (raises RuntimeError on a drill
+        failure; the supervisor reaps and reports).  Runs until every
+        child of the incarnation exited — resize exits included."""
+        nonlocal slo_429, slo_metrics_seen, shutdown_sent, final_status
+        fdstate["phase"] += 1
+        phase_no = fdstate["phase"]
 
-        # endpoint discovery (rank 0 publishes frontdoor.p0.json)
+        # endpoint discovery (rank 0 publishes frontdoor.p0.json; the ts
+        # check skips a stale file from the previous incarnation)
         deadline = _time.monotonic() + args.timeout
         client = None
         while _time.monotonic() < deadline:
-            if any(q.poll() is not None for q in procs):
-                return _fail("a child exited before opening the front door")
+            if any(q.poll() is not None for q in inc.procs):
+                raise RuntimeError(
+                    f"phase {phase_no}: a child exited before opening the "
+                    f"front door"
+                )
             if os.path.isfile(endpoint_file):
                 try:
                     with open(endpoint_file) as f:
                         doc = _json.load(f)
-                    client = _DoorClient(f"{doc['host']}:{doc['port']}")
-                    client.get("/v1/status")
-                    break
+                    if float(doc.get("ts") or 0) >= inc.t0:
+                        client = _DoorClient(f"{doc['host']}:{doc['port']}")
+                        client.get("/v1/status")
+                        break
+                    client = None
                 except (OSError, ValueError):
                     client = None
             _time.sleep(0.1)
         if client is None:
-            return _fail("front-door endpoint never became reachable")
+            raise RuntimeError(
+                f"phase {phase_no}: front-door endpoint never became "
+                f"reachable"
+            )
 
         # phase-specific load
         if phase_no == 1:
@@ -703,7 +725,9 @@ def supervise_frontdoor(args) -> bool:
                 else:
                     _time.sleep(0.1)
             if armed < 2:
-                return _fail("initial submissions never accepted")
+                raise RuntimeError(
+                    f"phase {phase_no}: initial submissions never accepted"
+                )
             # ...wait for round 1 (the stall wedges right after it) so the
             # probes below cannot pile up as pending QUEUE load and trip
             # the autoscaler before the stall leg has run...
@@ -719,9 +743,10 @@ def supervise_frontdoor(args) -> bool:
             # wedge outlasts any resize decision (the serving thread IS the
             # decision loop), so this completes before phase 1 can end.
             while _time.monotonic() < deadline and slo_429 is None:
-                if any(q.poll() is not None for q in procs):
-                    return _fail(
-                        "children exited before the stall produced a 429"
+                if any(q.poll() is not None for q in inc.procs):
+                    raise RuntimeError(
+                        f"phase {phase_no}: children exited before the "
+                        f"stall produced a 429"
                     )
                 code, body = client.post("/v1/submit", {
                     "tenant": probe[0], "model": "diffusion3d",
@@ -738,12 +763,15 @@ def supervise_frontdoor(args) -> bool:
                         slo_metrics_seen = True
                 _time.sleep(0.1)
             if slo_429 is None:
-                return _fail("injected stall never produced a 429 reason=slo")
+                raise RuntimeError(
+                    f"phase {phase_no}: injected stall never produced a "
+                    f"429 reason=slo"
+                )
             # the burst that outruns cap1 and drives the scale-up, plus the
             # two long members the scale-down must later carry live (a
             # resize may land mid-burst; leftovers hit the next door)
             to_submit.extend(burst[2:] + long_jobs)
-        elif phase["nproc"] > 1:
+        elif inc.nranks > 1:
             # traffic THROUGH the resized (2-process) door
             to_submit.extend(mid_traffic)
 
@@ -752,7 +780,7 @@ def supervise_frontdoor(args) -> bool:
             if to_submit and _try_submit(client, *to_submit[0], phase_no):
                 to_submit.pop(0)
             _poll_done(client)
-            if all(q.poll() is not None for q in procs):
+            if not inc.alive():
                 break
             if (
                 not shutdown_sent
@@ -772,37 +800,49 @@ def supervise_frontdoor(args) -> bool:
                 except OSError:
                     pass
             _time.sleep(0.1)
-        for q in procs:
-            try:
-                q.wait(timeout=args.timeout)
-            except subprocess.TimeoutExpired:
-                return _fail("children did not exit")
-        for f in logs:
-            f.close()
-        rcs = [q.returncode for q in procs]
-        phase_log.append({"phase": phase_no, **{k: phase[k] for k in
-                                                ("nproc", "capacity", "rung")},
-                          "rcs": rcs})
-        if all(rc == RESIZE_STATUS for rc in rcs):
-            plan_path = os.path.join(ckpt, "resize.json")
-            try:
-                with open(plan_path) as f:
-                    plan = _json.load(f)
-            except (OSError, ValueError) as e:
-                return _fail(f"resize exit without a readable plan ({e!r})")
-            os.remove(plan_path)
-            phase = {"nproc": int(plan["nproc"]),
-                     "capacity": int(plan["capacity"]),
-                     "rung": int(plan["rung"]), "resume": True, "port": 0}
-            phase_log[-1]["plan"] = {k: plan[k] for k in
-                                     ("nproc", "capacity", "rung", "reason")}
-            continue
-        if all(rc == 0 for rc in rcs) and shutdown_sent:
-            break
-        return _fail(f"unexpected child rc(s) {rcs}")
+        if inc.alive():
+            raise RuntimeError(f"phase {phase_no}: children did not exit")
+
+    rsup = sup.RunSupervisor(
+        command_for,
+        ladder=[2, 1],       # rung 0 = the 2-proc topology, rung 1 = 1-proc
+        initial_rung=1,      # the drill starts small and scales up
+        preferred_rung=0,
+        workdir=run_dir,
+        telemetry_dir=tele_dir,
+        policy=sup.RecoveryPolicy(max_restarts=0, backoff_s=0.2),
+        # the SLO-breach leg: wedge the serving thread after round 1 (the
+        # supervisor prunes the fired stall from every later incarnation)
+        fault_spec="stall:step1",
+        env={
+            "PYTHONPATH": _elastic_env({})["PYTHONPATH"],
+            "IGG_TELEMETRY": "1", "IGG_HEARTBEAT_EVERY": "1",
+            "IGG_SERVE_PORT": "0",
+            "IGG_AUTOSCALE_QUEUE_HIGH": "3", "IGG_AUTOSCALE_SUSTAIN": "1",
+            "IGG_FRONTDOOR_QUEUE_MAX": "64",
+        },
+        drive=drive,
+        on_resize=on_resize,
+        resize_plan_path=os.path.join(ckpt, "resize.json"),
+        grace_s=30.0,
+        poll_s=0.3,
+        name="frontdoor",
+    )
+    report = rsup.run(timeout=args.timeout + 60, max_incarnations=6)
+    if not report.ok:
+        _dump_run_logs(run_dir)
+        return _report("frontdoor", False, f"supervisor: {report.summary()}")
+    bad_kinds = [i["kind"] for i in report.incidents
+                 if i["kind"] not in ("healthy", "resize")]
+    if bad_kinds:
+        _dump_run_logs(run_dir)
+        return _report("frontdoor", False,
+                       f"unexpected incident kind(s) {bad_kinds}")
+    if not shutdown_sent:
+        return _report("frontdoor", False,
+                       "the drill never reached the clean-shutdown phase")
 
     # -- acceptance ----------------------------------------------------------
-    resize_plans = [p["plan"] for p in phase_log if "plan" in p]
     ups = [p for p in resize_plans if p["reason"] == "up"]
     downs = [p for p in resize_plans if "down" in p["reason"]]
     if not (ups and ups[0]["nproc"] == 2):
@@ -844,16 +884,17 @@ def supervise_frontdoor(args) -> bool:
         "rounds": rounds,
         "rounds_per_s": round(rps, 3),
         "resizes": len(resize_plans),
-        "phases": phase_log,
+        "plans": resize_plans,
+        "incidents": report.incidents,
     }
     with open(os.path.join(workdir, "frontdoor_soak.json"), "w") as f:
         _json.dump(record, f, indent=1)
     return _report(
         "frontdoor", True,
-        f"{len(accepted)} requests across {len(phase_log)} phases "
-        f"(up@2proc + drain/down@1proc), all digests == oracle; stall -> "
-        f"429 reason=slo (+/metrics counter); p50 {p50:.2f}s p99 {p99:.2f}s "
-        f"{rps:.2f} rounds/s",
+        f"{len(accepted)} requests across {len(report.incidents)} "
+        f"supervised phases (up@2proc + drain/down@1proc), all digests == "
+        f"oracle; stall -> 429 reason=slo (+/metrics counter); "
+        f"p50 {p50:.2f}s p99 {p99:.2f}s {rps:.2f} rounds/s",
     )
 
 
@@ -1261,23 +1302,42 @@ def _verify_elastic_telemetry(tele_dir: str, got_out: str) -> tuple[bool, str]:
     )
 
 
+def _dump_run_logs(run_dir: str) -> None:
+    import glob as _glob_mod
+
+    for path in sorted(_glob_mod.glob(os.path.join(run_dir, "*.log"))):
+        print(f"----- {path}", file=sys.stderr)
+        with open(path) as f:
+            print(f.read(), file=sys.stderr)
+
+
 def supervise_elastic_failover(args) -> bool:
-    """The supervisor: run the 2-process job, detect the injected crash,
-    relaunch on a shrunk 1-process topology from the latest VALID
-    checkpoint, and verify against a never-crashed oracle."""
+    """The supervised-failover drill, now a thin wrapper over
+    `igg.supervisor.RunSupervisor` (the subsystem this scenario used to
+    hand-roll): the supervisor launches the 2-process pair with the
+    crash + corrupt-newest-generation faults armed, detects the crash,
+    classifies it, and — with ``max_restarts=0`` — its policy engine
+    drops straight to the shrunk 1-process rung, relaunching from the
+    latest VALID checkpoint.  Verification against the never-crashed
+    oracle (and the telemetry/flight/trace acceptance) is unchanged."""
     import shutil
 
     import numpy as np
 
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu import supervisor as sup
+
     workdir = args.workdir
     ckpt = os.path.join(workdir, "ckpt_elastic")
+    run_dir = os.path.join(workdir, "elastic_run")
     shutil.rmtree(ckpt, ignore_errors=True)
+    shutil.rmtree(run_dir, ignore_errors=True)
     # Telemetry armed for the pair AND the restart (same directory): the
     # drill must yield one machine-readable cross-process timeline.  The
     # oracle leg stays un-armed — its events would pollute the timeline.
     tele_dir = os.path.join(workdir, "telemetry_elastic")
     shutil.rmtree(tele_dir, ignore_errors=True)
-    tele_env = {"IGG_TELEMETRY": "1", "IGG_TELEMETRY_DIR": tele_dir}
     if args.steps < 6:
         return _report(
             "elastic", False,
@@ -1298,77 +1358,304 @@ def supervise_elastic_failover(args) -> bool:
         print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
         return _report("elastic", False, f"oracle rc={proc.returncode}")
 
-    # (2) the 2-process job with crash + newest-generation corruption armed
-    port = _free_port()
-    env = _elastic_env(
-        {
-            "IGG_FAULT_INJECT": f"worker_crash:step{mid}:proc1,ckpt_corrupt:step{mid}",
-            **tele_env,
-        }
-    )
-    logs = [
-        open(os.path.join(workdir, f"elastic_pair{pid}.log"), "w+")
-        for pid in range(2)
-    ]
-    procs = [
-        subprocess.Popen(
-            _elastic_cmd(args, nproc=2, pair_id=pid, port=port, ckpt=ckpt,
-                         out=os.path.join(workdir, "elastic_never.npy")),
-            env=env, stdout=logs[pid], stderr=subprocess.STDOUT, text=True,
-        )
-        for pid in range(2)
-    ]
-    try:
-        try:
-            procs[1].wait(timeout=args.timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            return _report("elastic", False, "pair run timed out before the crash")
-        # crash detected: reap the stranded survivor like any supervisor would
-        try:
-            procs[0].wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            procs[0].kill()
-            procs[0].wait()
-        if procs[1].returncode != CRASH_STATUS:
-            logs[1].flush()
-            logs[1].seek(0)
-            print(logs[1].read(), file=sys.stderr)
-            return _report(
-                "elastic", False,
-                f"expected crash rc={CRASH_STATUS}, got {procs[1].returncode}",
-            )
-    finally:
-        for f in logs:
-            f.close()
-
-    # (3) relaunch on the SHRUNK 1-process topology: must fall back past the
-    # corrupt step-`mid` generation to step `mid`-2 and reshard elastically
+    # (2) the supervised run: `RunSupervisor` owns the pair end to end —
+    # spawn with the faults armed, detect/classify the crash, shrink
+    # (max_restarts=0: the first strike walks the ladder), relaunch
+    # against the same checkpoint directory with the fired faults pruned.
     got_out = os.path.join(workdir, "elastic_resumed.npy")
-    proc = _run_child(
-        _elastic_cmd(args, nproc=1, pair_id=0, port=0, ckpt=ckpt, out=got_out,
-                     expect_resume=mid - 2),
-        _elastic_env(dict(tele_env)), args.timeout,
+    launch = {"gen": None, "port": 0}
+
+    def command_for(rank, nranks, rung, gen):
+        if launch["gen"] != gen:
+            launch["gen"] = gen
+            launch["port"] = _free_port()
+        return _elastic_cmd(
+            args, nproc=nranks, pair_id=rank, port=launch["port"], ckpt=ckpt,
+            out=got_out, expect_resume=(mid - 2) if nranks == 1 else -1,
+        )
+
+    rsup = sup.RunSupervisor(
+        command_for,
+        ladder=[2, 1],
+        workdir=run_dir,
+        telemetry_dir=tele_dir,
+        policy=sup.RecoveryPolicy(max_restarts=0, backoff_s=0.2),
+        fault_spec=f"worker_crash:step{mid}:proc1,ckpt_corrupt:step{mid}",
+        env={"PYTHONPATH": _elastic_env({})["PYTHONPATH"],
+             "IGG_TELEMETRY": "1"},
+        grace_s=30.0,
+        poll_s=0.3,
+        name="elastic",
     )
-    if proc.returncode != 0:
-        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
-        return _report("elastic", False, f"shrunk restart rc={proc.returncode}")
+    report = rsup.run(timeout=args.timeout)
+    if not report.ok:
+        _dump_run_logs(run_dir)
+        return _report("elastic", False, f"supervisor: {report.summary()}")
+    kinds = [i["kind"] for i in report.incidents]
+    actions = [i["decision"]["action"] for i in report.incidents]
+    if "shrink" not in actions:
+        return _report(
+            "elastic", False,
+            f"supervisor never took the shrink leg (kinds {kinds}, "
+            f"actions {actions})",
+        )
+    crash_inc = report.incidents[0]
+    if CRASH_STATUS not in crash_inc["rcs"]:
+        _dump_run_logs(run_dir)
+        return _report(
+            "elastic", False,
+            f"expected crash rc={CRASH_STATUS} in the first incident, got "
+            f"{crash_inc['rcs']}",
+        )
     oracle = np.load(oracle_out)
     got = np.load(got_out)
     ok = got.shape == oracle.shape and np.allclose(
         got, oracle, rtol=1e-13, atol=1e-13
     )
-    # (4) the observability acceptance: rank-tagged event timeline in order
+    # (3) the observability acceptance: rank-tagged event timeline in order
     # + a valid metrics dump with per-step T_eff (docs/observability.md).
     tele_ok, tele_detail = _verify_elastic_telemetry(tele_dir, got_out)
     if not tele_ok:
         return _report("elastic", False, f"telemetry: {tele_detail}")
     return _report(
         "elastic", ok,
-        f"crash rc=17 -> fallback to step {mid - 2} -> 1-proc restart "
+        f"supervised: {' -> '.join(f'{k}/{a}' for k, a in zip(kinds, actions))} "
+        f"across {report.generations + 1} generation(s) "
         f"(max |err| {np.max(np.abs(got - oracle)) if got.shape == oracle.shape else 'shape mismatch'}); "
         f"telemetry: {tele_detail}",
+    )
+
+
+#: fault kinds the chaos drill samples (the storm the acceptance names:
+#: crash + stall + ckpt_corrupt + net_delay)
+CHAOS_DRILL_KINDS = ("worker_crash", "stall", "net_delay", "ckpt_corrupt")
+CHAOS_DRILL_RATE = 0.8
+
+
+def _chaos_pick_seed(steps: int) -> tuple[int, list[str]]:
+    """First seed whose deterministic `chaos_schedule` expansion is a
+    qualifying storm: exactly TWO crashes (so the supervisor exercises the
+    restart-in-place leg AND the strikes-exhausted shrink leg), at least
+    one stall and one net_delay, and a ckpt_corrupt at an even
+    (checkpointed) step with a crash at that step or the next — the
+    configuration that leaves the NEWEST generation damaged when the
+    restart reads the directory, so the integrity fallback runs inside
+    the storm.  The scan is deterministic: every invocation (and any
+    debugging rerun) derives the same seed from the same ``steps``."""
+    from implicitglobalgrid_tpu.utils.resilience import chaos_schedule
+
+    for seed in range(100000):
+        specs = chaos_schedule(
+            seed, CHAOS_DRILL_RATE, steps=steps, kinds=CHAOS_DRILL_KINDS
+        )
+        by_kind: dict[str, list[int]] = {}
+        for s in specs:
+            kind, step = s.split(":")
+            by_kind.setdefault(kind, []).append(int(step[len("step"):]))
+        crashes = sorted(by_kind.get("worker_crash", []))
+        if len(crashes) != 2:
+            continue
+        if not by_kind.get("stall") or not by_kind.get("net_delay"):
+            continue
+        # exactly ONE ckpt_corrupt, at an even (checkpointed) step >= 4:
+        # the step-(c-2) generation is valid and on disk by the time step
+        # c's save is damaged, so the shrink leg's fallback lands on a
+        # real generation and the 2->1-process ELASTIC reshard runs —
+        # damaging the only generation would make the "recovery" a silent
+        # from-scratch rerun instead
+        corrupts = by_kind.get("ckpt_corrupt", [])
+        if len(corrupts) != 1 or corrupts[0] % 2 or corrupts[0] < 4:
+            continue
+        # ...and the SECOND crash (the strikes-exhausted shrink trigger)
+        # lands at the damaged step or the next, so that generation is the
+        # newest when the shrunk incarnation reads the directory
+        if not corrupts[0] <= crashes[1] <= corrupts[0] + 1:
+            continue
+        return seed, specs
+    raise RuntimeError(
+        f"no chaos seed under 100000 satisfies the storm predicate at "
+        f"steps={steps}"
+    )
+
+
+def _verify_chaos_events(tele_dir: str) -> tuple[bool, str]:
+    """The chaos drill's machine-readable acceptance: every storm kind
+    fired, the wedged loop was caught live (``alert.step_stall``), and the
+    supervisor's detect → classify → recover transitions bracket both
+    recovery legs IN ORDER on the merged per-rank timeline."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu.utils.telemetry import read_events
+
+    files = sorted(glob.glob(os.path.join(tele_dir, "events*.jsonl")))
+    if not files:
+        return False, f"no events*.jsonl under {tele_dir}"
+    events = [e for f in files for e in read_events(f)]
+    if any(
+        "rank" not in e or not isinstance(e.get("ts"), (int, float))
+        for e in events
+    ):
+        return False, "event lines missing rank/ts tags"
+    events.sort(key=lambda e: e["ts"])
+    types = [str(e.get("type")) for e in events]
+    missing_kinds = [
+        k for k in CHAOS_DRILL_KINDS if f"fault.{k}" not in types
+    ]
+    if missing_kinds:
+        return False, f"storm kind(s) never fired: {missing_kinds}"
+    if "alert.step_stall" not in types:
+        return False, (
+            "the injected stall never surfaced as a live alert.step_stall "
+            "(scrape-time rule) on any rank"
+        )
+    milestones = (
+        ("crash #1", lambda e: e["type"] == "fault.worker_crash"),
+        ("detect #1", lambda e: e["type"] == "supervisor.detect"),
+        ("classify #1", lambda e: e["type"] == "supervisor.classify"),
+        ("recover/restart", lambda e: e["type"] == "supervisor.recover"
+         and e.get("action") == "restart"),
+        ("crash #2", lambda e: e["type"] == "fault.worker_crash"),
+        ("detect #2", lambda e: e["type"] == "supervisor.detect"),
+        ("classify #2", lambda e: e["type"] == "supervisor.classify"),
+        ("recover/shrink", lambda e: e["type"] == "supervisor.recover"
+         and e.get("action") == "shrink"),
+        ("elastic reshard", lambda e: e["type"] == "checkpoint.restore"
+         and e.get("mode") == "elastic"),
+        ("recovery", lambda e: e["type"] == "run.complete"),
+    )
+    i = 0
+    for name, pred in milestones:
+        while i < len(events) and not pred(events[i]):
+            i += 1
+        if i >= len(events):
+            seen = sorted(set(types))
+            return False, (
+                f"chaos timeline missing '{name}' (in order); saw {seen}"
+            )
+        i += 1
+    fallbacks = types.count("checkpoint.fallback")
+    gens = sorted({e.get("gen") for e in events if e.get("gen") is not None})
+    return True, (
+        f"{len(events)} events across {len(files)} file(s): all "
+        f"{len(CHAOS_DRILL_KINDS)} storm kinds fired, stall caught live, "
+        f"detect->classify->recover in order through restart AND shrink, "
+        f"{fallbacks} integrity fallback(s), generations {gens}"
+    )
+
+
+def supervise_chaos(args) -> bool:
+    """The chaos drill (module docstring): a seeded randomized multi-fault
+    storm over a REAL 2-process gloo pair, owned end to end by
+    `igg.supervisor.RunSupervisor` — the supervisor detects each failure
+    (process liveness + live ``/healthz`` scrapes), classifies it, restarts
+    in place, then shrinks elastically once the strikes are spent, and the
+    final de-duplicated field must be BIT-IDENTICAL to an undisturbed
+    oracle."""
+    import shutil
+
+    import numpy as np
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu import supervisor as sup
+
+    workdir = args.workdir
+    ckpt = os.path.join(workdir, "ckpt_chaos")
+    run_dir = os.path.join(workdir, "chaos_run")
+    tele_dir = os.path.join(workdir, "telemetry_chaos")
+    for d in (ckpt, run_dir, tele_dir):
+        shutil.rmtree(d, ignore_errors=True)
+    steps = max(6, args.steps)
+    seed, storm = _chaos_pick_seed(steps)
+    print(f"[soak] chaos storm (seed {seed}): {', '.join(storm)}")
+
+    # (1) the undisturbed oracle (1-process topology, no faults, no
+    # telemetry — its events would pollute the storm timeline)
+    oracle_out = os.path.join(workdir, "chaos_oracle.npy")
+    oargs = argparse.Namespace(**vars(args))
+    oargs.steps = steps
+    proc = _run_child(
+        _elastic_cmd(oargs, nproc=1, pair_id=0, port=0, ckpt=None,
+                     out=oracle_out),
+        _elastic_env({}), args.timeout,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        return _report("chaos", False, f"oracle rc={proc.returncode}")
+
+    # (2) the supervised storm
+    got_out = os.path.join(workdir, "chaos_resumed.npy")
+    launch = {"gen": None, "port": 0}
+
+    def command_for(rank, nranks, rung, gen):
+        if launch["gen"] != gen:
+            launch["gen"] = gen
+            launch["port"] = _free_port()
+        return _elastic_cmd(
+            oargs, nproc=nranks, pair_id=rank, port=launch["port"],
+            ckpt=ckpt, out=got_out,
+        )
+
+    rsup = sup.RunSupervisor(
+        command_for,
+        ladder=[2, 1],
+        workdir=run_dir,
+        telemetry_dir=tele_dir,
+        policy=sup.RecoveryPolicy(max_restarts=1, backoff_s=0.2, seed=seed),
+        fault_spec=(
+            f"chaos:seed={seed}:rate={CHAOS_DRILL_RATE}:steps={steps}"
+            f":kinds={'+'.join(CHAOS_DRILL_KINDS)}"
+        ),
+        env={
+            "PYTHONPATH": _elastic_env({})["PYTHONPATH"],
+            "IGG_TELEMETRY": "1",
+            # the live plane the supervisor polls: per-rank ephemeral
+            # scrape servers + heartbeat-cadence rule evaluation
+            "IGG_METRICS_PORT": "0",
+            "IGG_HEARTBEAT_EVERY": "1",
+        },
+        grace_s=30.0,
+        poll_s=0.3,
+        name="chaos",
+    )
+    report = rsup.run(timeout=args.timeout, max_incarnations=6)
+    if not report.ok:
+        _dump_run_logs(run_dir)
+        return _report("chaos", False, f"supervisor: {report.summary()}")
+    actions = [i["decision"]["action"] for i in report.incidents]
+    kinds = [i["kind"] for i in report.incidents]
+    if "restart" not in actions or "shrink" not in actions:
+        return _report(
+            "chaos", False,
+            f"storm did not exercise both recovery legs (kinds {kinds}, "
+            f"actions {actions})",
+        )
+
+    # (3) bit-identity in dedup space vs the undisturbed oracle
+    oracle = np.load(oracle_out)
+    got = np.load(got_out)
+    if got.shape != oracle.shape or not np.array_equal(got, oracle):
+        detail = (
+            "shape mismatch" if got.shape != oracle.shape
+            else f"max |err| {np.max(np.abs(got - oracle))}"
+        )
+        return _report(
+            "chaos", False,
+            f"final dedup field differs from the oracle ({detail})",
+        )
+
+    # (4) the event-order acceptance
+    ev_ok, ev_detail = _verify_chaos_events(tele_dir)
+    if not ev_ok:
+        return _report("chaos", False, f"events: {ev_detail}")
+    return _report(
+        "chaos", True,
+        f"seed {seed}: {len(storm)} faults -> "
+        f"{' -> '.join(f'{k}/{a}' for k, a in zip(kinds, actions))} across "
+        f"{report.generations + 1} generation(s), final field bit-identical "
+        f"to the oracle; {ev_detail}",
     )
 
 
@@ -1382,7 +1669,8 @@ def orchestrate(args) -> int:
     # shared 8-device baseline is only needed by the other scenarios.
     baseline = None
     if any(
-        s not in ("elastic_failover", "serving", "live_plane", "frontdoor")
+        s not in ("elastic_failover", "serving", "live_plane", "frontdoor",
+                  "chaos")
         for s in args.scenarios
     ):
         proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
@@ -1400,6 +1688,10 @@ def orchestrate(args) -> int:
             continue
         if scenario == "live_plane":
             if not supervise_live_plane(args):
+                failures += 1
+            continue
+        if scenario == "chaos":
+            if not supervise_chaos(args):
                 failures += 1
             continue
         if scenario == "frontdoor":
@@ -1490,6 +1782,11 @@ def orchestrate(args) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "scenario", nargs="*", choices=[[], *SCENARIOS],
+        help="scenario(s) to run positionally (e.g. `soak.py chaos "
+        "--quick`); default: --scenarios (or every scenario)",
+    )
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--nx", type=int, default=8)
     ap.add_argument("--devices", type=int, default=8)
@@ -1540,9 +1837,16 @@ def main() -> int:
         return child_frontdoor_oracle(args)
     if args.child:
         return child_main(args)
-    if args.quick:
+    if args.scenario:
+        # positional selection wins (and composes with --quick's sizing):
+        # `python scripts/soak.py chaos --quick` is the CI registration
+        args.scenarios = list(args.scenario)
+        if args.quick:
+            args.steps = min(args.steps, 6)
+            args.timeout = min(args.timeout, 300)
+    elif args.quick:
         args.scenarios = ["elastic_failover", "serving", "live_plane",
-                          "frontdoor"]
+                          "frontdoor", "chaos"]
         args.steps = min(args.steps, 6)
         args.timeout = min(args.timeout, 300)
     return orchestrate(args)

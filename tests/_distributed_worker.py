@@ -185,8 +185,15 @@ if mesh_arg:
     for round_ in range(2):
         buf = np.zeros_like(Tf) if jax.process_index() == 0 else None
         assert igg.gather(diffusion3d.temperature(state), buf, root=0) is None
-        if jax.process_index() == 0:
-            assert np.array_equal(buf, Tf), (
+        if jax.process_index() == 0 and not np.array_equal(buf, Tf):
+            # supervisor-visible escalation (docs/robustness.md): the
+            # tripwire leaves a classified flight bundle, not a generic
+            # crash (`supervisor.classify` maps reason=gather_tripwire)
+            igg.tracing.dump_flight_recorder(
+                "gather_tripwire", round=round_, mesh=list(MESH_DIMS),
+                nproc=nproc,
+            )
+            raise AssertionError(
                 f"fill-in-place gather round {round_} mixed blocks on the "
                 f"{nproc}-process mesh"
             )
@@ -236,8 +243,16 @@ else:
 for round_ in range(3):
     buf = np.zeros_like(got) if jax.process_index() == ROOT else None
     assert igg.gather(T, buf, root=ROOT) is None
-    if jax.process_index() == ROOT:
-        assert np.array_equal(buf, got), (
+    if jax.process_index() == ROOT and not np.array_equal(buf, got):
+        # The ROADMAP watch-item's supervisor-visible escalation path: a
+        # tripped gather tripwire records a flight bundle whose
+        # reason=gather_tripwire classifies as a TRANSPORT fault
+        # (`igg.supervisor.classify`) instead of vanishing into a generic
+        # worker crash — suspect the jax-0.4.37 gloo transport itself.
+        igg.tracing.dump_flight_recorder(
+            "gather_tripwire", round=round_, nproc=nproc,
+        )
+        raise AssertionError(
             f"fill-in-place gather round {round_} mixed blocks (gloo "
             f"transport cross-match recurrence? see ROADMAP open items)"
         )

@@ -1,0 +1,133 @@
+"""The save-vs-prune race (ISSUE 14 satellite).
+
+`RunGuard` prunes right after each save, a supervisor may prune a shared
+directory while a rank is mid-save, and the staged-save design
+(docs/robustness.md) is what makes that safe: an in-flight generation
+lives under a hidden ``.step_*.tmp`` name until its manifest is complete,
+so a concurrent `prune_checkpoints` can neither see it, count it against
+retention, nor leave it as a manifest-less partial for
+`latest_checkpoint` to pick.  These tests pin that contract by injecting
+a prune (and a crash) into the middle of a save — between the shard
+bytes landing and the manifest/rename publish — via the save's integrity
+hook (`_crc32_file`, the last step before the manifest is assembled).
+"""
+
+import os
+
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.utils import checkpoint as ckpt
+
+NX = 8
+
+
+@pytest.fixture
+def grid():
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    yield igg.get_global_grid()
+
+
+def _state():
+    T0 = igg.zeros((NX, NX, NX))
+    X, Y, Z = igg.coord_fields(T0, (0.37, 0.11, 0.53))
+    return (X * 1.3 + Y * 0.7 + Z * 0.11,)
+
+
+def _mid_save_hook(monkeypatch, hook):
+    """Run ``hook(shard_path)`` at the point mid-save where this process's
+    shard bytes are on disk but the manifest is NOT yet written and the
+    generation is NOT yet published (the widest race window)."""
+    real = ckpt._crc32_file
+    fired = {"n": 0}
+
+    def wrapper(path, *a, **kw):
+        if fired["n"] == 0 and os.sep + "." in path:
+            # first CRC of a STAGED (.step_*.tmp) shard = mid-save
+            fired["n"] += 1
+            hook(path)
+        return real(path, *a, **kw)
+
+    monkeypatch.setattr(ckpt, "_crc32_file", wrapper)
+    return fired
+
+
+def test_concurrent_prune_mid_save_never_exposes_a_partial(
+    grid, tmp_path, monkeypatch
+):
+    state = _state()
+    d = str(tmp_path)
+    p2 = ckpt.save_checkpoint(d, state, 2)
+    p4 = ckpt.save_checkpoint(d, state, 4)
+    observed = {}
+
+    def prune_mid_save(_path):
+        # the race: retention fires while step 6 is staging.  The staged
+        # generation must be invisible to the scan...
+        observed["steps"] = [s for s, _ in ckpt.checkpoint_steps(d)]
+        observed["removed"] = ckpt.prune_checkpoints(d, keep=1)
+        # ...and whatever latest_checkpoint picks AT THIS INSTANT must be
+        # a complete, integrity-verified generation — never the partial.
+        pick = ckpt.latest_checkpoint(d)
+        observed["pick"] = pick
+        observed["pick_problem"] = ckpt.verify_checkpoint(pick)
+
+    fired = _mid_save_hook(monkeypatch, prune_mid_save)
+    p6 = ckpt.save_checkpoint(d, state, 6)
+    assert fired["n"] == 1, "the mid-save hook never fired"
+    assert observed["steps"] == [2, 4]  # the staging dir stayed hidden
+    assert observed["removed"] == [p2]
+    assert observed["pick"] == p4 and observed["pick_problem"] is None
+    # the completed save publishes atomically and wins cleanly
+    assert ckpt.latest_checkpoint(d) == p6
+    assert ckpt.verify_checkpoint(p6) is None
+    restored, step, _ = ckpt.restore_checkpoint(p6, like=state)
+    assert step == 6
+
+
+def test_crash_mid_save_plus_prune_leaves_latest_valid(
+    grid, tmp_path, monkeypatch
+):
+    """A save that DIES mid-flight (after pruning already ran against the
+    directory) must leave no visible partial: `latest_checkpoint` keeps
+    returning the newest COMPLETE generation, and the torn staging dir
+    never matches the ``step_*`` scan."""
+    state = _state()
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, state, 2)
+    p4 = ckpt.save_checkpoint(d, state, 4)
+
+    def prune_then_die(_path):
+        ckpt.prune_checkpoints(d, keep=1)
+        raise RuntimeError("injected crash mid-save")
+
+    _mid_save_hook(monkeypatch, prune_then_die)
+    with pytest.raises(RuntimeError, match="injected crash"):
+        ckpt.save_checkpoint(d, state, 6)
+    # the torn generation is invisible; the newest complete one wins
+    assert [s for s, _ in ckpt.checkpoint_steps(d)] == [4]
+    assert ckpt.latest_checkpoint(d) == p4
+    assert ckpt.verify_checkpoint(p4) is None
+    # the hidden staging residue exists but can never be picked
+    residue = [n for n in os.listdir(d) if n.startswith(".step_")]
+    assert residue  # the crash really did leave a torn staging dir behind
+
+
+def test_prune_keep1_cannot_delete_the_generation_being_replaced(
+    grid, tmp_path, monkeypatch
+):
+    """keep=1 with a single existing generation while a newer one stages:
+    the stager must not count toward retention, so the only complete
+    generation survives until the new one PUBLISHES."""
+    state = _state()
+    d = str(tmp_path)
+    p2 = ckpt.save_checkpoint(d, state, 2)
+
+    def prune_mid_save(_path):
+        assert ckpt.prune_checkpoints(d, keep=1) == []
+        assert ckpt.latest_checkpoint(d) == p2
+
+    _mid_save_hook(monkeypatch, prune_mid_save)
+    p4 = ckpt.save_checkpoint(d, state, 4)
+    assert ckpt.latest_checkpoint(d) == p4
+    assert ckpt.prune_checkpoints(d, keep=1) == [p2]

@@ -562,3 +562,97 @@ def test_endpoint_file_published(monkeypatch, tmp_path):
         assert tele.snapshot()["gauges"]["frontdoor.port"] == fd.port
     finally:
         fd.close()
+
+
+# -- HTTP hardening (ISSUE 14 satellite) --------------------------------------
+
+
+def test_http_oversize_body_refused_with_structured_413():
+    import http.client
+
+    loop = _pool(capacity=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fd.port, timeout=10)
+        conn.putrequest("POST", "/v1/submit")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", str(fdm.MAX_BODY_DEFAULT + 1))
+        conn.endheaders()
+        # the refusal must arrive WITHOUT the server buffering the body
+        resp = conn.getresponse()
+        body = json.loads(resp.read().decode())
+        conn.close()
+        assert resp.status == 413
+        assert body["max_bytes"] == fdm.MAX_BODY_DEFAULT
+        assert body["bytes"] == fdm.MAX_BODY_DEFAULT + 1
+        assert tele.snapshot()["counters"]["frontdoor.oversize_total"] == 1
+    finally:
+        fd.close()
+
+
+def test_http_malformed_content_length_is_structured_400():
+    import http.client
+
+    loop = _pool(capacity=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        for bad in ("abc", "-5"):
+            conn = http.client.HTTPConnection("127.0.0.1", fd.port,
+                                              timeout=10)
+            conn.putrequest("POST", "/v1/submit")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", bad)
+            conn.endheaders()
+            resp = conn.getresponse()
+            body = json.loads(resp.read().decode())
+            conn.close()
+            assert resp.status == 400, bad
+            assert "Content-Length" in body["error"], body
+    finally:
+        fd.close()
+
+
+def test_http_max_body_env_tier(monkeypatch):
+    monkeypatch.setenv("IGG_SERVE_MAX_BODY", "64")
+    loop = _pool(capacity=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        doc = {"tenant": "t", "model": "diffusion3d",
+               "params": {"max_steps": 1, "ic_scale": 1.0,
+                          "padding": "x" * 256}}
+        code, body, _ = _post(fd.port, "/v1/submit", doc)
+        assert code == 413 and body["max_bytes"] == 64
+        # under the bound the request flows into normal validation
+        code, body, _ = _post(fd.port, "/v1/submit",
+                              {"params": {"max_steps": 1}})
+        assert code == 202
+    finally:
+        fd.close()
+
+
+def test_http_malformed_json_and_missing_fields_are_structured_400s():
+    loop = _pool(capacity=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fd.port}/v1/submit", data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+        assert "bad JSON body" in json.loads(e.value.read().decode())["error"]
+        # a missing params object is the validation 400, never a 500
+        code, body, _ = _post(fd.port, "/v1/submit", {"tenant": "t"})
+        assert code == 400 and "params" in body["error"]
+    finally:
+        fd.close()
+
+
+def test_handler_socket_timeouts_armed():
+    """The slow-loris hardening: every per-connection handler carries a
+    socket timeout, so a client trickling bytes is dropped instead of
+    pinning a handler thread forever (frontdoor AND the liveplane)."""
+    handler = fdm._make_handler(object())
+    assert handler.timeout == fdm.SOCKET_TIMEOUT_S > 0
+    assert lp._Handler.timeout and lp._Handler.timeout > 0

@@ -1,0 +1,770 @@
+"""The self-healing run supervisor (ISSUE 14; docs/robustness.md).
+
+Four surfaces, each pinned at the unit level plus one real end-to-end
+supervision loop over fake workers:
+
+* generation fencing — publish/read round-trip, monotonicity, and the
+  acceptance contract: a process carrying a STALE generation token is
+  refused at `save_checkpoint` / the resize publish / the endpoint-file
+  write, and every refusal lands as a rank-tagged ``fence.rejected``
+  telemetry event;
+* failure classification — the pure evidence -> class matrix;
+* the recovery-policy engine — restart strikes, shrink, scale-up,
+  quarantine, give-up, deterministic backoff; and `recovery_plan`'s
+  rank-invariance as censused by the ``collective-consistency`` analyzer
+  (with the seeded POSITIVE divergence fixture);
+* the chaos plane — seeded `chaos_schedule` determinism, spec expansion /
+  round-trip, the ``net_delay`` kind, and the supervisor's fired-fault
+  pruning.
+
+The real 2-process gloo storm lives in ``scripts/soak.py chaos --quick``
+(docs/testing.md); these tests keep the machinery pinned in tier-1.
+"""
+
+import os
+import sys
+import time
+import types
+
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu import supervisor as sup
+from implicitglobalgrid_tpu.supervisor import generation as gen_mod
+from implicitglobalgrid_tpu.utils import checkpoint as ckpt
+from implicitglobalgrid_tpu.utils import resilience as res
+from implicitglobalgrid_tpu.utils import telemetry as tele
+from implicitglobalgrid_tpu.utils import tracing
+
+NX = 8
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("IGG_"):
+            monkeypatch.delenv(k)
+    res.reset_fault_injector()
+    tele.reset()
+    yield monkeypatch
+    res.reset_fault_injector()
+    tele.reset()
+
+
+def _events(path):
+    return tele.read_events(path)
+
+
+# -- generation tokens + fencing ----------------------------------------------
+
+
+def test_generation_publish_read_roundtrip(clean_env, tmp_path):
+    d = str(tmp_path)
+    assert gen_mod.authoritative_generation(d) is None
+    gen_mod.publish_generation(3, d, reason="test")
+    assert gen_mod.authoritative_generation(d) == 3
+    gen_mod.publish_generation(3, d)  # same token republishes fine
+    with pytest.raises(ValueError, match="monotonic"):
+        gen_mod.publish_generation(2, d)
+    assert gen_mod.authoritative_generation(d) == 3
+
+
+def test_unfenced_process_never_refused(clean_env, tmp_path):
+    # no IGG_GENERATION: every check passes whatever the fence file says
+    gen_mod.publish_generation(9, str(tmp_path))
+    clean_env.setenv("IGG_FENCE_DIR", str(tmp_path))
+    assert gen_mod.fence_refusal("checkpoint.save") is None
+    gen_mod.check_fence("checkpoint.save")  # no raise
+
+
+def test_stale_token_refused_with_rank_tagged_event(clean_env, tmp_path):
+    fence = tmp_path / "fence"
+    telem = tmp_path / "telemetry"
+    gen_mod.publish_generation(2, str(fence))
+    clean_env.setenv("IGG_FENCE_DIR", str(fence))
+    clean_env.setenv("IGG_GENERATION", "1")
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    with pytest.raises(gen_mod.FenceError) as e:
+        gen_mod.check_fence("checkpoint.save")
+    assert e.value.generation == 1 and e.value.authoritative == 2
+    events = _events(telem / "events.jsonl")
+    rej = [x for x in events if x["type"] == "fence.rejected"]
+    assert rej and rej[0]["what"] == "checkpoint.save"
+    assert rej[0]["generation"] == 1 and rej[0]["authoritative"] == 2
+    assert "rank" in rej[0]
+    assert rej[0]["gen"] == 1  # the event itself carries the stale token
+    assert tele.snapshot()["counters"]["fence.rejected_total"] == 1
+
+
+def test_current_token_passes_fence(clean_env, tmp_path):
+    gen_mod.publish_generation(2, str(tmp_path))
+    clean_env.setenv("IGG_FENCE_DIR", str(tmp_path))
+    clean_env.setenv("IGG_GENERATION", "2")
+    assert not gen_mod.fence_refused("anything")
+
+
+def test_save_checkpoint_fenced_and_meta_carries_generation(
+    clean_env, tmp_path
+):
+    fence = tmp_path / "fence"
+    telem = tmp_path / "telemetry"
+    ckdir = tmp_path / "ckpt"
+    clean_env.setenv("IGG_FENCE_DIR", str(fence))
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    clean_env.setenv("IGG_GENERATION", "1")
+    gen_mod.publish_generation(1, str(fence))
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.zeros((NX, NX, NX))
+    # current generation: the save succeeds and records its token
+    path = ckpt.save_checkpoint(ckdir, (T,), 2)
+    assert ckpt.checkpoint_meta(path)["generation"] == 1
+    # the supervisor moves on; the zombie's next publish is REFUSED
+    gen_mod.publish_generation(2, str(fence))
+    with pytest.raises(gen_mod.FenceError):
+        ckpt.save_checkpoint(ckdir, (T,), 4)
+    assert ckpt.latest_checkpoint(ckdir) == path  # nothing new published
+    rej = [
+        x for x in _events(telem / "events.jsonl")
+        if x["type"] == "fence.rejected"
+    ]
+    assert rej and rej[-1]["what"] == "checkpoint.save"
+    assert "rank" in rej[-1]
+
+
+def test_liveplane_endpoint_write_fenced(clean_env, tmp_path):
+    from implicitglobalgrid_tpu.utils import liveplane
+
+    fence = tmp_path / "fence"
+    telem = tmp_path / "telemetry"
+    clean_env.setenv("IGG_FENCE_DIR", str(fence))
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    clean_env.setenv("IGG_GENERATION", "0")
+    gen_mod.publish_generation(1, str(fence))
+    server = types.SimpleNamespace(host="127.0.0.1", port=12345)
+    liveplane._publish_endpoint(server)
+    assert not os.path.isfile(telem / liveplane.endpoint_filename(0))
+    rej = [
+        x for x in _events(telem / "events.jsonl")
+        if x["type"] == "fence.rejected"
+    ]
+    assert rej and rej[0]["what"] == "liveplane.endpoint"
+
+
+def test_frontdoor_resize_publish_fenced(clean_env, tmp_path):
+    from implicitglobalgrid_tpu.serving.frontdoor import FrontDoor
+
+    fence = tmp_path / "fence"
+    telem = tmp_path / "telemetry"
+    clean_env.setenv("IGG_FENCE_DIR", str(fence))
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    clean_env.setenv("IGG_GENERATION", "0")
+    gen_mod.publish_generation(1, str(fence))
+    fd = FrontDoor.__new__(FrontDoor)  # the fence gate precedes any state
+    with pytest.raises(gen_mod.FenceError):
+        fd._execute_resize({"nproc": 2, "capacity": 4, "rung": 1})
+    rej = [
+        x for x in _events(telem / "events.jsonl")
+        if x["type"] == "fence.rejected"
+    ]
+    assert rej and rej[0]["what"] == "frontdoor.resize"
+
+
+def test_frontdoor_control_broadcast_generation_mismatch_refused(
+    clean_env, tmp_path
+):
+    from implicitglobalgrid_tpu.serving.frontdoor import FrontDoor
+
+    telem = tmp_path / "telemetry"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    clean_env.setenv("IGG_GENERATION", "2")
+    fd = FrontDoor.__new__(FrontDoor)
+    assert fd._apply({"gen": 1, "shutdown": True}) is None  # refused whole
+    rej = [
+        x for x in _events(telem / "events.jsonl")
+        if x["type"] == "fence.rejected"
+    ]
+    assert rej and rej[0]["what"] == "frontdoor.control"
+    # a matching stamp applies normally
+    assert fd._apply({"gen": 2, "shutdown": True}) == "shutdown"
+
+
+def test_event_lines_carry_generation_tag(clean_env, tmp_path):
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tele.event("x")  # unfenced: no gen key
+    clean_env.setenv("IGG_GENERATION", "5")
+    tele.event("y")
+    events = {e["type"]: e for e in _events(tmp_path / "events.jsonl")}
+    assert "gen" not in events["x"]
+    assert events["y"]["gen"] == 5
+
+
+# -- checkpoint fallback-depth gauge (satellite) ------------------------------
+
+
+def test_latest_checkpoint_publishes_fallback_depth(clean_env, tmp_path):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.zeros((NX, NX, NX))
+    ckdir = tmp_path / "ckpt"
+    p2 = ckpt.save_checkpoint(ckdir, (T,), 2)
+    p4 = ckpt.save_checkpoint(ckdir, (T,), 4)
+    assert ckpt.latest_checkpoint(ckdir) == p4
+    assert tele.gauge_value("checkpoint.fallback_depth") == 0
+    # damage the newest generation: the walk must skip it AND publish how
+    # far it limped back
+    shard = os.path.join(p4, "shards_p0.npz")
+    with open(shard, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff")
+    assert ckpt.latest_checkpoint(ckdir) == p2
+    assert tele.gauge_value("checkpoint.fallback_depth") == 1
+
+
+def test_fallback_depth_event_emitted(clean_env, tmp_path, monkeypatch):
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path / "telemetry"))
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    T = igg.zeros((NX, NX, NX))
+    ckdir = tmp_path / "ckpt"
+    p2 = ckpt.save_checkpoint(ckdir, (T,), 2)
+    p4 = ckpt.save_checkpoint(ckdir, (T,), 4)
+    os.remove(os.path.join(p4, "shards_p0.npz"))
+    assert ckpt.latest_checkpoint(ckdir) == p2
+    depth = [
+        e for e in _events(tmp_path / "telemetry" / "events.jsonl")
+        if e["type"] == "checkpoint.fallback_depth"
+    ]
+    assert depth and depth[-1]["depth"] == 1 and "rank" in depth[-1]
+
+
+# -- failure classification ---------------------------------------------------
+
+
+def _ev(kind, **kw):
+    return {"type": kind, "ts": time.time(), "rank": kw.pop("rank", 0), **kw}
+
+
+def test_exit_status_constants_agree():
+    """The host-only classifier keeps a literal RESIZE_STATUS (importing
+    the serving package would pull the model zoo in); this pin ties every
+    copy to its canonical definition."""
+    from implicitglobalgrid_tpu.serving.frontdoor import RESIZE_STATUS
+    from implicitglobalgrid_tpu.supervisor import classify as classify_fn  # noqa: F401
+    from implicitglobalgrid_tpu.supervisor.classify import (
+        CRASH_STATUS as SUP_CRASH,
+        RESIZE_STATUS as SUP_RESIZE,
+    )
+
+    assert SUP_CRASH == res.FaultInjector.CRASH_STATUS == 17
+    assert SUP_RESIZE == RESIZE_STATUS == 19
+
+
+def test_classify_matrix():
+    C = sup.classify
+    assert C([0, 0]).kind == "healthy"
+    assert C([19, 19]).kind == "resize"
+    assert C([0, 17]).kind == "crash"
+    assert C([0, 17]).detail.get("injected") is True
+    assert C([1, 0]).ranks == (0,)
+    # mixed resize is a failed broadcast, not a resize
+    mixed = C([0, 19])
+    assert mixed.kind == "crash" and mixed.detail["mixed_resize"] is True
+
+
+def test_classify_specific_bundles_win():
+    ev = {"bundles": {1: [_ev(None, reason="gather_tripwire")]},
+          "alerts": [], "events": []}
+    inc = sup.classify([0, 1], ev)
+    assert inc.kind == "gather_tripwire"
+    assert inc.detail["bundle_reason"] == "gather_tripwire"
+    ev = {"bundles": {0: [_ev(None, reason="guard.trip")]},
+          "alerts": [], "events": []}
+    assert sup.classify([1, 0], ev).kind == "guard_trip"
+    ev = {"bundles": {0: [_ev(None, reason="watchdog.deadline_exceeded")]},
+          "alerts": [], "events": []}
+    assert sup.classify([None, None], ev).kind == "step_stall"
+
+
+def test_classify_clean_exit_demotes_recovered_bundles_to_detail():
+    """A guard trip whose rollback SUCCEEDED (all ranks exited 0) left a
+    flight bundle — classifying it as a failure would restart a finished
+    job, so it must ride as detail on a healthy incident."""
+    ev = {"bundles": {0: [_ev(None, reason="guard.trip")]},
+          "alerts": [], "events": []}
+    inc = sup.classify([0, 0], ev)
+    assert inc.kind == "healthy" and not inc.failed
+    assert inc.detail["bundle_reason"] == "guard.trip"
+    # same for a blown watchdog deadline the loop outlived, on a resize
+    ev = {"bundles": {1: [_ev(None, reason="watchdog.deadline_exceeded")]},
+          "alerts": [], "events": []}
+    assert sup.classify([19, 19], ev).kind == "resize"
+
+
+def test_classify_sigkilled_ranks_count_as_killed():
+    """The manager's grace/timeout reap delivers rc=-9 (SIGKILL), which
+    must satisfy the killed-not-crashed contract the stall/straggler
+    classes key on — the supervisor's real kill path, not just the
+    synthetic rc=None evidence."""
+    stall = _ev("alert.step_stall", rank=1)
+    ev = {"bundles": {}, "alerts": [stall], "events": [stall]}
+    assert sup.classify([-9, -9], ev).kind == "step_stall"
+    skew = _ev("skew.straggler", rank=1)
+    ev = {"bundles": {}, "alerts": [], "events": [skew]}
+    assert sup.classify([-9, None], ev).kind == "straggler"
+    # a rank that died of a real signal (segfault) is still a crash
+    ev = {"bundles": {}, "alerts": [stall], "events": [stall]}
+    assert sup.classify([-11, -9], ev).kind == "crash"
+
+
+def test_classify_corrupt_checkpoint_and_stall_and_straggler():
+    ckpt_ev = _ev("checkpoint.fallback", problem="shard corrupt")
+    ev = {"bundles": {}, "alerts": [], "events": [ckpt_ev]}
+    assert sup.classify([17, 0], ev).kind == "corrupt_checkpoint"
+    stall = _ev("alert.step_stall", rank=1)
+    ev = {"bundles": {}, "alerts": [stall], "events": [stall]}
+    # killed-while-wedged = stall; a clean exit demotes it to detail
+    assert sup.classify([None, None], ev).kind == "step_stall"
+    clean = sup.classify([0, 0], ev)
+    assert clean.kind == "healthy"
+    assert clean.detail["transient_alerts"] == ["alert.step_stall"]
+    assert sup.classify([19, 19], ev).kind == "resize"
+    skew = _ev("skew.straggler", rank=1)
+    ev = {"bundles": {}, "alerts": [], "events": [skew]}
+    assert sup.classify([None, None], ev).kind == "straggler"
+
+
+def test_classify_suspect_ranks_follow_the_evidence_not_the_exits():
+    """Quarantine must target the rank the integrity evidence names — a
+    corrupting rank can take innocent peers down with it."""
+    # the damaged shard file names its WRITER rank (rank 0), even though
+    # the rank that died was rank 1
+    ckpt_ev = _ev(
+        "checkpoint.fallback",
+        problem="shard shards_p0.npz corrupt: CRC32 0x1 on disk vs 0x2",
+    )
+    ev = {"bundles": {}, "alerts": [], "events": [ckpt_ev]}
+    inc = sup.classify([0, 17], ev)
+    assert inc.kind == "corrupt_checkpoint" and inc.ranks == (0,)
+    assert inc.rcs == (0, 17)  # the exit picture stays visible
+    # a flight bundle's own rank is the implicated one likewise
+    ev = {"bundles": {0: [_ev(None, reason="gather_tripwire")]},
+          "alerts": [], "events": []}
+    assert sup.classify([0, 1], ev).ranks == (0,)
+
+
+def test_classify_since_ts_filters_previous_incarnations():
+    old = dict(_ev("checkpoint.fallback"), ts=100.0)
+    ev = {"bundles": {}, "alerts": [], "events": [old]}
+    assert sup.classify([17, 0], ev, since_ts=200.0).kind == "crash"
+    assert sup.classify([17, 0], ev, since_ts=50.0).kind == "corrupt_checkpoint"
+
+
+def test_collect_evidence_reads_bundles_and_alerts(tmp_path, clean_env):
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tele.event("alert.step_stall", severity="critical")
+    tracing.dump_flight_recorder("gather_tripwire", round=2)
+    ev = sup.collect_evidence(str(tmp_path))
+    assert 0 in ev["bundles"]
+    assert ev["bundles"][0][-1]["reason"] == "gather_tripwire"
+    assert [a["type"] for a in ev["alerts"]] == ["alert.step_stall"]
+    assert sup.collect_evidence(str(tmp_path / "missing")) == {
+        "bundles": {}, "alerts": [], "events": []
+    }
+
+
+def test_collect_evidence_incremental_offsets(tmp_path, clean_env):
+    """The supervisor's offset map makes each collection parse only the
+    lines appended since the previous one (a long run's shared telemetry
+    history must not be re-read per incident)."""
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    offsets: dict = {}
+    tele.event("fault.worker_crash", step=2)
+    ev1 = sup.collect_evidence(str(tmp_path), offsets=offsets)
+    assert [e["type"] for e in ev1["events"]] == ["fault.worker_crash"]
+    tele.event("alert.step_stall", severity="critical")
+    ev2 = sup.collect_evidence(str(tmp_path), offsets=offsets)
+    assert [e["type"] for e in ev2["events"]] == ["alert.step_stall"]
+    assert [a["type"] for a in ev2["alerts"]] == ["alert.step_stall"]
+    # nothing new -> nothing parsed; a torn trailing line is NOT consumed
+    assert sup.collect_evidence(str(tmp_path), offsets=offsets)["events"] == []
+    path = tmp_path / "events.jsonl"
+    with open(path, "a") as f:
+        f.write('{"type": "fault.stall", "ts": 1.0, "rank": 0}')  # no \n
+    assert sup.collect_evidence(str(tmp_path), offsets=offsets)["events"] == []
+    with open(path, "a") as f:
+        f.write("\n")
+    got = sup.collect_evidence(str(tmp_path), offsets=offsets)["events"]
+    assert [e["type"] for e in got] == ["fault.stall"]
+
+
+# -- recovery policy ----------------------------------------------------------
+
+
+def test_policy_restart_then_shrink_then_give_up():
+    pol = sup.RecoveryPolicy(max_restarts=2, backoff_s=0.01)
+    st = sup.SupervisorState()
+    crash = sup.Incident(kind="crash", ranks=(1,), rcs=(0, 17), detail={})
+    for i in range(2):
+        d = sup.decide(crash, st, pol, ladder_len=2)
+        assert d.action == "restart" and d.rung == 0, (i, d)
+        assert d.delay_s > 0
+        st.apply(d)
+    d = sup.decide(crash, st, pol, ladder_len=2)
+    assert d.action == "shrink" and d.rung == 1
+    assert "IGG_SUPERVISE_MAX_RESTARTS" in d.reason
+    st.apply(d)
+    assert st.restarts == 0  # a shrink resets the streak
+    d = sup.decide(crash, st, pol, ladder_len=2)
+    assert d.action == "restart"  # fresh strikes at the new rung
+    st.apply(d)
+    st.apply(sup.decide(crash, st, pol, ladder_len=2))
+    d = sup.decide(crash, st, pol, ladder_len=2)
+    assert d.action == "give_up"
+
+
+def test_policy_healthy_and_scale_up():
+    pol = sup.RecoveryPolicy(max_restarts=1, backoff_s=0.01, scale_up_after=2)
+    healthy = sup.Incident(kind="healthy", ranks=(), rcs=(0,), detail={})
+    st = sup.SupervisorState(rung=1)
+    d = sup.decide(healthy, st, pol, ladder_len=2)
+    assert d.action == "none"  # streak 1 < scale_up_after
+    st.apply(d)
+    d = sup.decide(healthy, st, pol, ladder_len=2)
+    assert d.action == "scale_up" and d.rung == 0
+    # at the preferred rung, healthy is just healthy
+    st = sup.SupervisorState(rung=0)
+    assert sup.decide(healthy, st, pol, ladder_len=2).action == "none"
+
+
+def test_policy_quarantine_after_repeated_integrity_failures():
+    pol = sup.RecoveryPolicy(max_restarts=0, backoff_s=0.01,
+                             quarantine_after=2)
+    st = sup.SupervisorState()
+    inc = sup.Incident(kind="gather_tripwire", ranks=(1,), rcs=(0, 1),
+                       detail={})
+    # the manager's sequence: record the incident, THEN decide — strikes
+    # accumulate across incarnations in the state, not per decision
+    st.record_incident(inc)
+    d1 = sup.decide(inc, st, pol, ladder_len=3)
+    assert d1.action == "shrink"  # strike 1: no quarantine yet
+    assert st.suspect_strikes == {1: 1}
+    st.apply(d1)
+    st.record_incident(inc)
+    d2 = sup.decide(inc, st, pol, ladder_len=3)
+    assert d2.action == "quarantine" and d2.quarantined == (1,)
+    st.apply(d2)
+    assert 1 in st.quarantined
+    # no smaller rung left -> give_up carrying the quarantine verdict
+    st2 = sup.SupervisorState(rung=2, suspect_strikes={1: 2})
+    d3 = sup.decide(inc, st2, pol, ladder_len=3)
+    assert d3.action == "give_up" and d3.quarantined == (1,)
+    # a transient incident charges no strikes
+    st3 = sup.SupervisorState()
+    st3.record_incident(sup.Incident(kind="crash", ranks=(0,), rcs=(1,),
+                                     detail={}))
+    assert st3.suspect_strikes == {}
+
+
+def test_policy_decide_is_deterministic_and_env_tier(clean_env):
+    pol = sup.RecoveryPolicy(max_restarts=1, backoff_s=0.25, seed=3)
+    st = sup.SupervisorState()
+    crash = sup.Incident(kind="crash", ranks=(0,), rcs=(1,), detail={})
+    d1 = sup.decide(crash, st, pol, ladder_len=2)
+    d2 = sup.decide(crash, st, pol, ladder_len=2)
+    assert d1 == d2
+    clean_env.setenv("IGG_SUPERVISE_MAX_RESTARTS", "7")
+    clean_env.setenv("IGG_SUPERVISE_BACKOFF_S", "0.125")
+    pol = sup.RecoveryPolicy.from_env()
+    assert pol.max_restarts == 7 and pol.backoff_s == 0.125
+    assert sup.RecoveryPolicy.from_env(max_restarts=1).max_restarts == 1
+
+
+def test_recovery_plan_rank_and_fence_invariance():
+    for action in sup.ACTIONS:
+        assert sup.recovery_plan(True, action, False) == sup.recovery_plan(
+            False, action, False
+        )
+        # a stale incarnation refuses the directive on EVERY rank together
+        assert sup.recovery_plan(True, action, True) == ()
+    plan = sup.recovery_plan(False, "resize", False)
+    assert plan[0] == ("broadcast_control", "directive")
+    assert sum(1 for op in plan if op[0] == "save_checkpoint") == 2
+    assert sup.recovery_plan(True, "restart", False) == ()
+
+
+# -- the collective-consistency census (CI/tooling satellite) -----------------
+
+
+def test_supervisor_census_registered_and_consistent():
+    from implicitglobalgrid_tpu.analysis import collectives as coll
+
+    assert coll.supervisor_plan_censuses in coll.CENSUS_PROVIDERS
+    censuses = list(coll.supervisor_plan_censuses(None))
+    assert len(censuses) == 2 * len(sup.ACTIONS)
+    for census in censuses:
+        assert coll.check_rank_consistency(census) == [], census.name
+
+
+def test_supervisor_census_catches_rank_keyed_recovery_decision():
+    """The seeded POSITIVE fixture: a recovery plan keyed on rank-local
+    fence state (one stale rank skipping the checkpoint barriers its
+    peers enter) is exactly the deadlock class the detector pins."""
+    from implicitglobalgrid_tpu.analysis import collectives as coll
+    from implicitglobalgrid_tpu.analysis.ir import RankCensus
+
+    def broken_plan(rank):
+        # rank 1 thinks it is fenced and refuses; everyone else proceeds
+        return sup.recovery_plan(rank == 0, "resize", stale=(rank == 1))
+
+    census = RankCensus(
+        name="host/supervisor_recovery[broken-rank-keyed-fence]",
+        sequences={rank: broken_plan(rank) for rank in range(4)},
+    )
+    findings = coll.check_rank_consistency(census)
+    assert findings and findings[0].severity == "CRITICAL"
+    assert findings[0].code == "rank-divergent-sequence"
+
+
+# -- the chaos plane ----------------------------------------------------------
+
+
+def test_chaos_schedule_deterministic_and_bounded():
+    a = res.chaos_schedule(11, 0.5, steps=20)
+    assert a == res.chaos_schedule(11, 0.5, steps=20)
+    steps_seen = [int(s.split(":step")[1]) for s in a]
+    assert steps_seen == sorted(steps_seen)
+    assert len(set(steps_seen)) == len(steps_seen)  # <= one fault per step
+    assert all(s.split(":")[0] in res.CHAOS_KINDS for s in a)
+    assert res.chaos_schedule(11, 0.0, steps=20) == []
+    with pytest.raises(ValueError, match="rate"):
+        res.chaos_schedule(1, 1.5)
+    with pytest.raises(ValueError, match="steps"):
+        res.chaos_schedule(1, 0.5, steps=0)
+    with pytest.raises(ValueError, match="init_flake"):
+        res.chaos_schedule(1, 0.5, kinds=("init_flake",))
+
+
+def test_chaos_spec_parses_into_fault_set(clean_env):
+    fs = res.FaultSet.from_spec("chaos:seed=3:rate=0.7:steps=10")
+    assert fs.specs() == res.chaos_schedule(3, 0.7, steps=10)
+    fs2 = res.FaultSet.from_spec(
+        "chaos:seed=3:rate=0.7:steps=10:kinds=stall+net_delay"
+    )
+    assert all(s.split(":")[0] in ("stall", "net_delay") for s in fs2.specs())
+    # chaos composes with explicit faults, comma-separated
+    fs3 = res.FaultSet.from_spec(
+        "worker_crash:step4:proc1,chaos:seed=3:rate=0.3:steps=4"
+    )
+    assert fs3.specs()[0] == "worker_crash:step4:proc1"
+    with pytest.raises(ValueError, match="chaos"):
+        res.FaultSet.from_spec("chaos:seed=x:rate=0.5")
+    with pytest.raises(ValueError, match="chaos"):
+        res.FaultSet.from_spec("chaos:rate=0.5")
+    with pytest.raises(ValueError, match="chaos"):
+        res.FaultSet.from_spec("chaos:seed=1:rate=0.5:bogus=2")
+
+
+def test_fault_spec_roundtrip_and_event_matching():
+    for spec in ("worker_crash:step4:proc1", "net_delay:step2",
+                 "ckpt_corrupt:step6:shard1", "init_flake:2"):
+        assert res.FaultInjector.from_spec(spec).spec() == spec
+    fired = [
+        {"type": "fault.worker_crash", "step": 4},
+        {"type": "fault.net_delay", "step": 2},
+        {"type": "fault.init_flake", "remaining": 1},
+    ]
+    assert res.fault_event_matches_spec(fired, "worker_crash:step4:proc1")
+    assert res.fault_event_matches_spec(fired, "net_delay:step2")
+    assert res.fault_event_matches_spec(fired, "init_flake:2")
+    assert not res.fault_event_matches_spec(fired, "worker_crash:step5")
+    assert not res.fault_event_matches_spec(fired, "stall:step4")
+
+
+def test_net_delay_arms_the_collective_delay_hook(clean_env):
+    inj = res.FaultInjector.from_spec("net_delay:step3:proc0")
+    inj.maybe_net_delay(2)
+    assert tracing._collective_delay == 0.0
+    t0 = time.perf_counter()
+    inj.maybe_net_delay(3)
+    assert inj.fired
+    assert tracing._collective_delay == pytest.approx(inj.NET_DELAY_S)
+    # arming is instant — the latency lands in the next host collective
+    assert time.perf_counter() - t0 < 1.0
+    tracing.arm_collective_delay(0.01)
+    t0 = time.perf_counter()
+    tracing._consume_collective_delay()
+    assert time.perf_counter() - t0 >= 0.01
+    assert tracing._collective_delay == 0.0
+    tracing.reset()
+
+
+# -- RunSupervisor end to end (fake workers, no jax) --------------------------
+
+
+_FAKE_WORKER = r"""
+import json, os, sys, time
+gen = int(os.environ["IGG_GENERATION"])
+rank = int(sys.argv[1])
+tele = os.environ["IGG_TELEMETRY_DIR"]
+os.makedirs(tele, exist_ok=True)
+def event(etype, **kw):
+    rec = {"ts": time.time(), "type": etype, "rank": rank, "gen": gen, **kw}
+    name = "events.jsonl" if rank == 0 else f"events.p{rank}.jsonl"
+    with open(os.path.join(tele, name), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+faults = os.environ.get("IGG_FAULT_INJECT", "")
+if gen == 0 and rank == 1 and "worker_crash:step2" in faults:
+    event("fault.worker_crash", step=2, status=17)
+    sys.exit(17)
+if gen == 1 and rank == 1 and "worker_crash:step4" in faults:
+    event("fault.worker_crash", step=4, status=17)
+    sys.exit(17)
+event("run.complete", step=6)
+sys.exit(0)
+"""
+
+
+def test_run_supervisor_restart_shrink_and_fault_pruning(
+    clean_env, tmp_path
+):
+    workdir = tmp_path / "run"
+    tele_dir = tmp_path / "telemetry"
+    script = tmp_path / "worker.py"
+    script.write_text(_FAKE_WORKER)
+
+    def command_for(rank, nranks, rung, gen):
+        return [sys.executable, str(script), str(rank)]
+
+    rsup = sup.RunSupervisor(
+        command_for,
+        ladder=[2, 1],
+        workdir=str(workdir),
+        telemetry_dir=str(tele_dir),
+        policy=sup.RecoveryPolicy(max_restarts=1, backoff_s=0.01),
+        fault_spec="worker_crash:step2:proc1,worker_crash:step4:proc1,"
+                   "stall:step9",
+        poll_s=0.05,
+        grace_s=2.0,
+        name="fake",
+    )
+    report = rsup.run(timeout=30)
+    assert report.ok, report
+    actions = [i["decision"]["action"] for i in report.incidents]
+    assert actions[:2] == ["restart", "shrink"]
+    assert report.generations == 2
+    # fired faults were pruned per relaunch; the never-fired stall remains
+    assert rsup._fault_specs == ["stall:step9"]
+    # the fence file tracks the final generation
+    assert gen_mod.authoritative_generation(str(workdir)) == 2
+    # detect -> classify -> recover order on the shared timeline
+    events = _events(tele_dir / "events.jsonl")
+    types_seq = [e["type"] for e in events]
+    i_detect = types_seq.index("supervisor.detect")
+    i_classify = types_seq.index("supervisor.classify")
+    i_recover = types_seq.index("supervisor.recover")
+    assert i_detect < i_classify < i_recover
+    recovers = [e for e in events if e["type"] == "supervisor.recover"]
+    assert [e["action"] for e in recovers[:2]] == ["restart", "shrink"]
+    done = [e for e in events if e["type"] == "supervisor.done"]
+    assert done and done[-1]["ok"] is True
+
+
+def test_run_supervisor_resize_flow(clean_env, tmp_path):
+    workdir = tmp_path / "run"
+    tele_dir = tmp_path / "telemetry"
+    plan_path = tmp_path / "resize.json"
+    script = tmp_path / "worker.py"
+    script.write_text(r"""
+import json, os, sys
+gen = int(os.environ["IGG_GENERATION"])
+if gen == 0:
+    if int(sys.argv[1]) == 0:
+        with open(sys.argv[2], "w") as f:
+            json.dump({"nproc": 1, "capacity": 2, "rung": 0,
+                       "reason": "down"}, f)
+    sys.exit(19)
+sys.exit(0)
+""")
+
+    def command_for(rank, nranks, rung, gen):
+        return [sys.executable, str(script), str(rank), str(plan_path)]
+
+    seen_plans = []
+
+    def on_resize(plan):
+        seen_plans.append(plan)
+        return 1  # the 1-process rung
+
+    rsup = sup.RunSupervisor(
+        command_for,
+        ladder=[2, 1],
+        workdir=str(workdir),
+        telemetry_dir=str(tele_dir),
+        policy=sup.RecoveryPolicy(max_restarts=0, backoff_s=0.01),
+        on_resize=on_resize,
+        resize_plan_path=str(plan_path),
+        poll_s=0.05,
+        grace_s=2.0,
+        name="resize",
+    )
+    report = rsup.run(timeout=30)
+    assert report.ok, report
+    assert [i["kind"] for i in report.incidents] == ["resize", "healthy"]
+    assert seen_plans and seen_plans[0]["reason"] == "down"
+    assert not os.path.exists(plan_path)  # consumed
+
+
+def test_run_supervisor_gives_up_without_a_smaller_rung(clean_env, tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text("import sys; sys.exit(3)\n")
+
+    rsup = sup.RunSupervisor(
+        lambda rank, nranks, rung, gen: [sys.executable, str(script)],
+        ladder=[1],
+        workdir=str(tmp_path / "run"),
+        telemetry_dir=str(tmp_path / "telemetry"),
+        policy=sup.RecoveryPolicy(max_restarts=1, backoff_s=0.01),
+        poll_s=0.05,
+        name="doomed",
+    )
+    report = rsup.run(timeout=30)
+    assert not report.ok
+    assert [i["decision"]["action"] for i in report.incidents] == [
+        "restart", "give_up"
+    ]
+    assert "no smaller rung" in report.reason
+
+
+def test_run_supervisor_give_up_reports_its_quarantine(clean_env, tmp_path):
+    """A run that ENDS on a quarantine verdict must still name the bad
+    ranks in the report (the caller's exclude-this-host signal)."""
+    tele_dir = tmp_path / "telemetry"
+    script = tmp_path / "worker.py"
+    # every incarnation: rank 0 leaves a gather_tripwire bundle and dies
+    script.write_text(r"""
+import json, os, sys, time
+tele = os.environ["IGG_TELEMETRY_DIR"]
+os.makedirs(tele, exist_ok=True)
+with open(os.path.join(tele, "flight_0.json"), "a") as f:
+    f.write(json.dumps({"ts": time.time(), "rank": 0,
+                        "reason": "gather_tripwire"}) + "\n")
+sys.exit(1)
+""")
+    rsup = sup.RunSupervisor(
+        lambda rank, nranks, rung, gen: [sys.executable, str(script)],
+        ladder=[1],  # no smaller rung: quarantine must land as give_up
+        workdir=str(tmp_path / "run"),
+        telemetry_dir=str(tele_dir),
+        policy=sup.RecoveryPolicy(max_restarts=2, backoff_s=0.01,
+                                  quarantine_after=2),
+        poll_s=0.05,
+        name="quarantine",
+    )
+    report = rsup.run(timeout=30)
+    assert not report.ok
+    # strike 1 -> restart in place; strike 2 -> quarantine verdict, which
+    # becomes give_up at the bottom of a one-rung ladder — still carrying
+    # the quarantined rank into the report
+    assert report.quarantined == (0,)
+    assert [i["kind"] for i in report.incidents] == ["gather_tripwire"] * 2
+    assert [i["decision"]["action"] for i in report.incidents] == [
+        "restart", "give_up"
+    ]
