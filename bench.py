@@ -600,13 +600,35 @@ def main(out: str | None = None):
     # submit→result latencies; see _frontdoor_serving_record).
     _extra("frontdoor_serving", _frontdoor_serving_record)
 
+    def _profile_attribution():
+        # ISSUE 15: the measured device-timeline record — a windowed
+        # profiler capture on the virtual CPU mesh's communicating grid
+        # (`benchmarks/run.py profile` -> utils/profiling), parsed into
+        # per-scope device seconds and the measured comm/compute overlap
+        # fraction.  ``overlap_fraction`` is a REPORTED perf-gate key
+        # (analysis.perf.REPORTED_KEYS) — the trajectory a future gate
+        # regresses against, same on-ramp achieved_fraction took.
+        rec = _cpu_mesh_json(["profile"])
+        rec["note"] = (
+            "virtual 8-device CPU mesh: scope seconds are code-path "
+            "records; the overlap fraction is the measured "
+            "union-intersection of the capture's collective vs kernel "
+            "intervals (see scripts/igg_prof.py)"
+        )
+        return rec
+
+    _extra("profile_attribution", _profile_attribution)
+
     def _efficiency():
         # ISSUE 10: the cost-model reconciliation — achieved-vs-modeled
         # traffic per model (analysis/reconcile.py, compiled fresh on the
         # virtual CPU mesh), joined with THIS record's measured teffs:
         # measured_teff / achieved_fraction = the modeled GB/s the chip
         # actually sustained.  efficiency.*.achieved_fraction is a
-        # reported (not yet gated) perf-gate key (analysis.perf).
+        # reported (not yet gated) perf-gate key (analysis.perf).  Since
+        # ISSUE 15 the measured overlap fraction (extras.
+        # profile_attribution) rides the same report as a per-model
+        # measured-overlap column.
         from implicitglobalgrid_tpu.analysis.reconcile import join_measured
 
         report = _cpu_mesh_json(["reconcile"])
@@ -615,7 +637,9 @@ def main(out: str | None = None):
             "acoustic": extras.get("acoustic", {}).get("teff"),
             "porous": extras.get("porous_pt", {}).get("teff"),
         }
-        return join_measured(report, measured)
+        frac = extras.get("profile_attribution", {}).get("overlap_fraction")
+        overlap = {"diffusion": frac} if frac is not None else None
+        return join_measured(report, measured, measured_overlap=overlap)
 
     _extra("efficiency", _efficiency)
     # The observability surface is the record of record now: every bench

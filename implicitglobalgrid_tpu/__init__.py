@@ -101,6 +101,7 @@ from .utils.checkpoint import (
     verify_checkpoint,
 )
 from .utils import liveplane
+from .utils import profiling
 from .utils import telemetry
 from .utils import tracing
 from .utils.telemetry import dump_metrics, telemetry_snapshot
@@ -168,6 +169,7 @@ __all__ = [
     "trace_span",
     "dump_trace",
     "liveplane",
+    "profiling",
     # static-analysis subsystem (docs/static-analysis.md)
     "analysis",
     # batched multi-simulation serving (ISSUE 8; docs/api.md)

@@ -149,8 +149,14 @@ def gate_metrics(record: dict) -> dict:
 #: future gate has a trajectory to regress against before it starts
 #: failing PRs on it; the ``submit_to_result_*`` seconds are the raw
 #: front-door latencies whose inverses are gated (human-readable twins).
+#: ``overlap_fraction`` is the MEASURED comm/compute overlap of the
+#: device-timeline capture (``extras.profile_attribution``, ISSUE 15 —
+#: `utils.profiling.overlap_measure`): the number ROADMAP item 1's
+#: Pallas-native exchange must push up, on the same reported-first on-ramp
+#: achieved_fraction took (promote to GATED once a chip-env round records
+#: it).
 REPORTED_KEYS = ("achieved_fraction", "submit_to_result_p50_s",
-                 "submit_to_result_p99_s")
+                 "submit_to_result_p99_s", "overlap_fraction")
 
 
 def reported_metrics(record: dict) -> dict:

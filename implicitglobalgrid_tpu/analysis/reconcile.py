@@ -185,14 +185,20 @@ def reconcile_report(*, source: str = "baseline") -> dict:
     }
 
 
-def join_measured(report: dict, measured_teff_gbs: dict) -> dict:
+def join_measured(report: dict, measured_teff_gbs: dict,
+                  measured_overlap: dict | None = None) -> dict:
     """Attach measured ``T_eff`` values (``{model: GB/s}``) to a report.
 
     Adds ``measured_teff_gbs`` and ``modeled_actual_gbs`` (= measured /
     achieved_fraction — the modeled total-traffic rate that measurement
     implies) per model; models without a measurement or a fraction pass
-    through unchanged.  This is the `efficiency` extra ``bench.py``
-    attaches to every record.
+    through unchanged.  ``measured_overlap`` (``{model: fraction}``, the
+    device-timeline capture's comm/compute overlap from
+    `utils.profiling` — ISSUE 15) rides along as
+    ``measured_overlap_fraction``: the report then carries BOTH halves of
+    ROADMAP item 1's acceptance — how much of the modeled traffic is
+    mandatory, and how much of the fabric time the schedule actually hid.
+    This is the `efficiency` extra ``bench.py`` attaches to every record.
     """
     out = {"source": report.get("source"), "note": report.get("note"),
            "models": {}}
@@ -204,6 +210,8 @@ def join_measured(report: dict, measured_teff_gbs: dict) -> dict:
             rec["measured_teff_gbs"] = float(teff)
             if frac:
                 rec["modeled_actual_gbs"] = round(float(teff) / frac, 3)
+        if measured_overlap and measured_overlap.get(model) is not None:
+            rec["measured_overlap_fraction"] = float(measured_overlap[model])
         out["models"][model] = rec
     return out
 

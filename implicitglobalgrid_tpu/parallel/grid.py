@@ -476,16 +476,22 @@ def init_timing_functions() -> None:
 def profile_trace(logdir, **kwargs):
     """Profiler hook: record a `jax.profiler` trace of the enclosed block.
 
-    The reference's only instrumentation is `tic`/`toc`
-    (`/root/reference/src/tools.jl:230-236`); on TPU the runtime ships a full
-    tracer for free, so this wraps the timed region for TensorBoard/Perfetto::
+    Thin alias of `utils.profiling.profile_trace` — the ONE capture
+    implementation of the device-timeline plane (docs/observability.md):
+    ``create_perfetto_trace`` now defaults True so the capture always
+    emits the parseable ``*.trace.json.gz`` that
+    ``scripts/igg_prof.py attribute`` and ``igg_trace.py merge --device``
+    consume.  Kept at its historical home for API stability; new code
+    should prefer the env-armed windowed capture (``IGG_PROFILE=
+    steps:A-B``), which needs no code changes and writes the per-rank
+    capture meta the tooling discovers::
 
         with igg.profile_trace("/tmp/igg-trace"):
             for _ in range(100):
                 state = step(*state)
-        # inspect HLO ops, collective-permute overlap, HBM traffic per op
+        # then: python scripts/igg_prof.py attribute /tmp/igg-trace
     """
-    import jax
+    from ..utils import profiling as _profiling
 
-    with jax.profiler.trace(str(logdir), **kwargs):
+    with _profiling.profile_trace(logdir, **kwargs):
         yield

@@ -90,6 +90,22 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           every N steps print step time, steps/s and T_eff —
                           and, on multi-process grids, run the all-ranks
                           skew probe (`utils.tracing.skew_probe`)
+``IGG_PROFILE``           windowed device-timeline capture (`utils.profiling`,
+                          docs/observability.md): ``steps:A-B`` arms a
+                          `jax.profiler` capture around time-loop steps A..B
+                          (1-based, inclusive) of the next instrumented run;
+                          ``steps:N`` = steps 1..N.  Per-rank output under
+                          ``IGG_PROFILE_DIR`` / ``IGG_TELEMETRY_DIR``;
+                          unset = no capture (the default).  Consumed ONCE
+                          per process: the first instrumented run arms it
+                          (`utils.profiling.maybe_arm`; several loops in
+                          one process must not pay a profiler session
+                          each or overwrite the first capture)
+``IGG_PROFILE_DIR``       base directory for the per-rank profiler capture
+                          dirs (``profile.p<rank>/``); unset = under
+                          ``IGG_TELEMETRY_DIR`` (no directory at all
+                          degrades to a structured ``profile.capture_failed``
+                          event, never a crash)
 ``IGG_TRACE_RING``        capacity of the per-process host-span ring buffer
                           (`utils.tracing`; int >= 0, default 4096; 0
                           disables span recording entirely) — read per
@@ -442,6 +458,22 @@ def heartbeat_every_env() -> int | None:
     """``IGG_HEARTBEAT_EVERY``: rank-0 heartbeat cadence in steps (>= 0;
     0 = off)."""
     return _int_env("IGG_HEARTBEAT_EVERY", minimum=0)
+
+
+def profile_env() -> str | None:
+    """``IGG_PROFILE``: device-timeline capture window spec (``steps:A-B``
+    or ``steps:N``); unset/empty = no capture.  Parsed and validated by
+    `utils.profiling.parse_profile_window` (the error contract names the
+    variable and the accepted grammar)."""
+    val = os.environ.get("IGG_PROFILE")
+    return val or None
+
+
+def profile_dir_env() -> str | None:
+    """``IGG_PROFILE_DIR``: base directory for per-rank profiler capture
+    dirs (unset = derive from ``IGG_TELEMETRY_DIR``)."""
+    val = os.environ.get("IGG_PROFILE_DIR")
+    return val or None
 
 
 def trace_ring_env() -> int | None:

@@ -205,6 +205,49 @@ def pipelined_overlap_evidence(txt: str) -> dict:
     }
 
 
+#: HLO op-NAME vocabulary for device-timeline classification
+#: (`utils.profiling`): profiler trace events carry instruction NAMES
+#: (``collective-permute-start.3``, ``pad_add_fusion.1``, ``copy.17``), not
+#: instruction text, so this is the name-based sibling of `_op_kind` — one
+#: blessed vocabulary for both the HLO-text analyzers and the trace parser.
+#: "collective" moves bytes over the fabric; "kernel" is real compute
+#: (fusions, custom-calls — the Pallas launches — and the standalone
+#: heavyweights); everything else is "glue": copies, slices, control flow,
+#: layout shuffling — the cadence overhead per-op attribution exists to
+#: localize.
+COLLECTIVE_OP_TOKENS = (
+    "collective-permute",
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "reduce-scatter",
+    "collective-broadcast",
+)
+
+KERNEL_OP_TOKENS = ("fusion", "custom-call", "convolution", "dot")
+
+
+def classify_op_name(name: str) -> str:
+    """Classify one HLO instruction NAME as ``collective`` | ``kernel`` |
+    ``glue``.
+
+    Matches the vocabulary tokens as substrings of the name with any
+    trailing ``.N`` suffix intact (XLA embeds the op kind in generated
+    names: ``select_dynamic-update-slice_fusion.1`` is a fusion,
+    ``collective-permute-start.3`` a collective).  A name holding both a
+    collective and a kernel token classifies as collective — a fused
+    collective still occupies the fabric.
+    """
+    low = name.lower()
+    for tok in COLLECTIVE_OP_TOKENS:
+        if tok in low:
+            return "collective"
+    for tok in KERNEL_OP_TOKENS:
+        if tok in low:
+            return "kernel"
+    return "glue"
+
+
 def collective_waits(txt: str, big_elems: int) -> tuple[int, list[bool], int]:
     """Analyze every HLO computation holding collective-permutes.
 

@@ -1114,6 +1114,15 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard",
     finally:
         if _liveplane is not None:
             _liveplane.unsubscribe(guard.on_alert)
+        if tele is not None:
+            # Crash-safe capture stop (docs/observability.md device
+            # timeline): a profiler window still open when the loop exits
+            # through a guard trip / injected fault stops HERE, so the
+            # bytes already captured land next to the flight bundle
+            # instead of dying with the process state.  Never raises.
+            from . import profiling as _profiling
+
+            _profiling.close_open_capture("scope_exit")
 
 
 def _guarded_loop_body(step_fn, state, nt, it, guard, enabled,

@@ -812,6 +812,12 @@ class _StepLoop:
         from . import liveplane as _liveplane
 
         _liveplane.ensure_server()
+        # Device-timeline capture (utils.profiling, docs/observability.md):
+        # armed per run from the step pipeline exactly like the live-plane
+        # server above — None unless IGG_PROFILE names a step window.
+        from . import profiling as _profiling
+
+        self._profile = _profiling.maybe_arm(start_step)
         event("run.start", model=model, start_step=start_step,
               total_steps=total_steps, bytes_per_step=bytes_per_step)
 
@@ -832,6 +838,8 @@ class _StepLoop:
             self._teff.record(gbs)
             self._teff_g.set(gbs)
         note_progress(self.model, it)
+        if self._profile is not None:
+            self._profile.on_step(it)
         if self.heartbeat_every and it % self.heartbeat_every == 0:
             # The skew probe is a COLLECTIVE: every rank must run it at the
             # same step (hence outside the rank-0 gate below; single-process
@@ -874,6 +882,10 @@ class _StepLoop:
                       **_heartbeat_context(skew))
 
     def finish(self, it: int) -> None:
+        if self._profile is not None:
+            # a window still open past the last step (nt inside it) stops
+            # here so the capture lands with the run's artifacts
+            self._profile.close("run_complete")
         note_progress(self.model, it, done=True)
         event("run.complete", model=self.model, step=it)
 

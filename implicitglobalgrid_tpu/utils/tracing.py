@@ -784,6 +784,17 @@ def dump_flight_recorder(reason: str, **info: Any) -> str | None:
             "spans": span_records() + open_spans(),
         }
         try:
+            # An in-flight device capture (utils.profiling): a crash
+            # mid-window is explained by its dir/window/step — and the
+            # post-mortem knows a partial profiler dir is expected.
+            from . import profiling as _profiling
+
+            cap = _profiling.active_capture()
+            if cap is not None:
+                bundle["profile"] = cap
+        except Exception:
+            pass
+        try:
             line = json.dumps(bundle, default=str) + "\n"
         except (TypeError, ValueError):
             line = json.dumps(

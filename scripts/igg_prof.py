@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Attribute / diff device-timeline profiler captures (docs/observability.md).
+
+``IGG_PROFILE=steps:A-B`` (or `igg.profile_trace`) leaves a profiler
+capture per rank; this tool turns the raw Chrome/Perfetto JSON into the
+per-scope device-time attribution and the measured comm/compute overlap
+fraction — the numbers the "cadence glue" gap (docs/performance.md) and
+ROADMAP item 1's overlap acceptance are stated in::
+
+    python scripts/igg_prof.py attribute RUN_DIR            # capture meta dir
+    python scripts/igg_prof.py attribute trace.json.gz      # one trace file
+    python scripts/igg_prof.py attribute PROFILER_LOGDIR    # jax.profiler dir
+    python scripts/igg_prof.py diff RUN_A RUN_B             # cross-run drift
+
+``attribute`` accepts a telemetry/run directory (newest
+``profile.p<rank>.json`` capture meta per rank), a profiler log dir, or a
+``*.trace.json[.gz]`` file, and prints the scope table + overlap fraction
+(``--json`` for the machine-readable record).  ``diff`` attributes BOTH
+inputs and names the scope that ate the regression (positive delta = B
+spends more).  A malformed/truncated trace is a structured finding on
+stdout and exit 1 — never a traceback.
+Exit codes: 0 ok, 1 structured finding (bad trace), 2 bad input/usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _attribute_target(target: str) -> dict:
+    """Attribution record for one CLI target (file / profiler logdir /
+    run dir with capture metas).  Raises ValueError with the finding text
+    on malformed input."""
+    from implicitglobalgrid_tpu.utils import profiling
+
+    if os.path.isfile(target):
+        return profiling.attribute_trace(target)
+    if not os.path.isdir(target):
+        raise FileNotFoundError(f"{target}: no such file or directory")
+    metas = profiling.find_capture_metas(target)
+    if metas:
+        # A run dir: attribute every rank's capture, roll ranks up.
+        ranks = {}
+        merged_ops: list = []
+        for path in metas:
+            with open(path, encoding="utf-8") as f:
+                meta = json.load(f)
+            # resolve relative to the meta's own dir too, so archived /
+            # copied run dirs (cross-round diffing) stay attributable
+            trace = profiling.resolve_trace_path(
+                meta, os.path.dirname(os.path.abspath(path))
+            )
+            if not trace:
+                ranks[str(meta.get("rank"))] = {
+                    "error": "capture recorded no trace file"
+                }
+                continue
+            doc = profiling.load_trace(trace)
+            ops = profiling.device_ops(doc)
+            # distinct pids per rank keep the overlap measure per-track
+            for op in ops:
+                op["pid"] = (meta.get("rank"), op["pid"])
+            merged_ops.extend(ops)
+            ranks[str(meta.get("rank"))] = profiling.attribute_ops(ops)
+        rec = profiling.attribute_ops(merged_ops)
+        rec["per_rank"] = ranks
+        rec["trace"] = target
+        return rec
+    return profiling.attribute_capture(target)
+
+
+def _finding(kind: str, target: str, error: Exception) -> int:
+    print(
+        json.dumps(
+            {
+                "finding": kind,
+                "target": target,
+                "error": f"{type(error).__name__}: {error}",
+            }
+        )
+    )
+    return 1
+
+
+def cmd_attribute(args) -> int:
+    from implicitglobalgrid_tpu.utils import profiling
+
+    try:
+        rec = _attribute_target(args.target)
+    except FileNotFoundError as e:
+        print(f"igg_prof: {e}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as e:
+        return _finding("profile.parse_failed", args.target, e)
+    if args.json:
+        print(json.dumps(rec))
+    else:
+        print(profiling.render_attribution_table(rec))
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from implicitglobalgrid_tpu.utils import profiling
+
+    recs = []
+    for target in (args.a, args.b):
+        try:
+            recs.append(_attribute_target(target))
+        except FileNotFoundError as e:
+            print(f"igg_prof: {e}", file=sys.stderr)
+            return 2
+        except (OSError, ValueError) as e:
+            return _finding("profile.parse_failed", target, e)
+    delta = profiling.attribution_delta(*recs)
+    if args.json:
+        print(json.dumps(delta))
+    else:
+        print(profiling.render_delta_table(delta))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="igg_prof.py",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    at = sub.add_parser(
+        "attribute", help="per-scope device-time attribution of a capture"
+    )
+    at.add_argument("target",
+                    help="trace file, profiler logdir, or run dir with "
+                         "profile.p*.json capture metas")
+    at.add_argument("--json", action="store_true",
+                    help="machine-readable record instead of the table")
+    df = sub.add_parser(
+        "diff", help="attribute a drift between two runs/rounds"
+    )
+    df.add_argument("a", help="reference capture (file/logdir/run dir)")
+    df.add_argument("b", help="candidate capture (file/logdir/run dir)")
+    df.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cmd == "attribute":
+        return cmd_attribute(args)
+    return cmd_diff(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

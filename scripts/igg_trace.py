@@ -7,9 +7,18 @@ shared barrier-aligned clock (one track per rank, alignment offsets and
 their honesty bound in ``otherData.clock_alignment``)::
 
     python scripts/igg_trace.py merge RUN_DIR -o merged.json
+    python scripts/igg_trace.py merge RUN_DIR --device -o merged.json
     python scripts/igg_trace.py merge trace.p0.json trace.p1.json -o m.json
     python scripts/igg_trace.py validate merged.json
     python scripts/igg_trace.py summarize RUN_DIR
+
+``--device`` additionally joins each rank's profiler capture
+(``profile.p<rank>.json`` capture metas written by the ``IGG_PROFILE``
+windowed capture, `implicitglobalgrid_tpu.utils.profiling`) as device-op
+tracks on the same per-rank pids — host spans and device ops side by side
+in ONE valid Chrome trace, aligned through the shared `named_scope`
+namespace with the anchor uncertainty recorded in
+``otherData.device_alignment``.
 
 ``summarize`` prints a per-span-name aggregate table (count, total,
 p50/p99, max) over one or more per-rank dumps — the quick look that no
@@ -56,6 +65,30 @@ def cmd_merge(args) -> int:
     try:
         paths = _expand(args.inputs)
         doc = tracing.merge_trace_files(paths)
+        if args.device:
+            from implicitglobalgrid_tpu.utils import profiling
+
+            # capture metas live next to the trace files: search directory
+            # inputs AND the parent dirs of explicit trace.pN.json inputs
+            # (the stale-refusal remedy says "merge the current run's
+            # files explicitly" — --device must work in that form too)
+            dirs: list[str] = []
+            for item in args.inputs:
+                d = item if os.path.isdir(item) else os.path.dirname(
+                    os.path.abspath(item)
+                )
+                if d not in dirs:
+                    dirs.append(d)
+            metas: list[str] = []
+            for d in dirs:
+                metas.extend(profiling.find_capture_metas(d))
+            if not metas:
+                raise ValueError(
+                    "--device: no profile.p*.json capture metas next to "
+                    "the trace files (run with IGG_PROFILE=steps:A-B so "
+                    "each rank captures a device window)."
+                )
+            profiling.attach_device_tracks(doc, metas)
     except (OSError, ValueError) as e:
         print(f"igg_trace: {e}", file=sys.stderr)
         return 2
@@ -146,6 +179,10 @@ def main(argv=None) -> int:
                     help="trace.pN.json files and/or directories")
     mp.add_argument("-o", "--output", default="-",
                     help="merged trace path ('-' = stdout)")
+    mp.add_argument("--device", action="store_true",
+                    help="join each rank's IGG_PROFILE capture "
+                         "(profile.p*.json metas in the input dirs) as "
+                         "device-op tracks on the rank pids")
     vp = sub.add_parser("validate", help="check a merged Chrome trace")
     vp.add_argument("trace")
     sp = sub.add_parser(

@@ -464,6 +464,9 @@ assert _mine[0]["coords"] == list(igg.get_global_grid().coords), _mine
 # transport (both ranks enter the replicated share at steps 2 and 4 — a
 # cadence mismatch would deadlock right here, which is the point), then
 # dump this rank's span file for the parent's merged-Chrome-trace check.
+# ISSUE 15 rides the same loop: BOTH ranks arm a windowed device capture
+# (IGG_PROFILE=steps:2-3) so the parent can join each rank's device track
+# into the device-merged timeline (`igg_trace.py merge --device`).
 from implicitglobalgrid_tpu.utils import tracing as _tracing
 from implicitglobalgrid_tpu.utils.resilience import RunGuard, guarded_time_loop
 from implicitglobalgrid_tpu.utils.telemetry import teff_bytes
@@ -473,6 +476,7 @@ assert _tracing.clock_sync()["barrier"], (
     "clock sync"
 )
 os.environ["IGG_HEARTBEAT_EVERY"] = "2"
+os.environ["IGG_PROFILE"] = "steps:2-3"
 try:
     state5, params5 = diffusion3d.setup(NX, NX, NX, init_grid=False)
     state5 = guarded_time_loop(
@@ -482,6 +486,24 @@ try:
     )
 finally:
     del os.environ["IGG_HEARTBEAT_EVERY"]
+    del os.environ["IGG_PROFILE"]
+
+# The windowed capture landed: per-rank meta with a parseable attribution
+# over the real multi-process step program.
+from implicitglobalgrid_tpu.utils import profiling as _profiling
+
+_meta_path = os.path.join(
+    os.environ["IGG_TELEMETRY_DIR"], _profiling.profile_meta_filename(pid)
+)
+assert os.path.isfile(_meta_path), f"no capture meta at {_meta_path}"
+import json as _json
+
+with open(_meta_path) as _f:
+    _meta = _json.load(_f)
+assert _meta["rank"] == pid and _meta["window"] == [2, 3], _meta
+assert _meta["trace_path"] and os.path.isfile(_meta["trace_path"]), _meta
+assert "error" not in _meta["attribution"], _meta["attribution"]
+assert _meta["attribution"]["n_device_ops"] > 0, _meta["attribution"]
 _snap = tele.snapshot()
 assert _snap["gauges"].get("skew.step_seconds_max_over_min", 0.0) >= 1.0, (
     "skew probe did not publish its gauges over the gloo transport",

@@ -515,3 +515,42 @@ def test_two_process_merged_trace(dist_out_path):
         assert per["barrier_aligned"] is True
         assert isinstance(per["uncertainty_s"], (int, float))
         assert per["uncertainty_s"] >= 0
+
+
+def test_two_process_device_merged_trace(dist_out_path):
+    """ISSUE 15 acceptance, the real-boundary leg: both ranks of the gloo
+    pair armed ``IGG_PROFILE=steps:2-3`` around the instrumented loop, so
+    the run dir holds one capture meta + device trace per rank — and
+    ``igg_trace.py merge --device`` must join BOTH ranks' device tracks
+    into the ONE barrier-aligned Chrome trace, still valid, each rank's
+    device ops on its own pid with the anchor honesty bound recorded."""
+    import glob
+    import json
+
+    from implicitglobalgrid_tpu.utils import profiling, tracing
+
+    tdir = dist_out_path + ".telemetry"
+    metas = profiling.find_capture_metas(tdir)
+    assert len(metas) == 2, f"expected both ranks' capture metas, got {metas}"
+    files = sorted(glob.glob(os.path.join(tdir, "trace.p*.json")))
+    doc = tracing.merge_trace_files(files)
+    profiling.attach_device_tracks(doc, metas)
+    doc = json.loads(json.dumps(doc))  # serializable + re-loadable
+    assert tracing.validate_chrome_trace(doc) == []
+    device = [
+        e for e in doc["traceEvents"]
+        if e.get("ph") == "X" and (e.get("args") or {}).get("hlo_op")
+    ]
+    assert {e["pid"] for e in device} == {0, 1}, (
+        "both ranks' device tracks must be present"
+    )
+    for rank in (0, 1):
+        ops = [e for e in device if e["pid"] == rank]
+        assert all(
+            e["tid"] >= profiling.DEVICE_TID_BASE for e in ops
+        ), "device ops must ride dedicated device tids"
+        assert all(e["args"].get("igg_scope") for e in ops)
+    dev_align = doc["otherData"]["device_alignment"]
+    assert set(dev_align["per_rank"]) == {"0", "1"}
+    for rank in ("0", "1"):
+        assert dev_align["per_rank"][rank]["n_ops"] > 0
