@@ -176,14 +176,16 @@ __all__ = [
     "serving",
     # self-healing run supervisor (docs/robustness.md)
     "supervisor",
+    # multi-pool fleet tier (ISSUE 16; docs/serving.md)
+    "fleet",
 ]
 
 
 def __getattr__(name):
     # Lazy: the serving subsystem pulls the model zoo in and the
-    # supervisor is host-orchestration-only; importing igg itself must
-    # stay light (mirrors `models.__getattr__`).
-    if name in ("serving", "supervisor"):
+    # supervisor/fleet tiers are host-orchestration-only; importing igg
+    # itself must stay light (mirrors `models.__getattr__`).
+    if name in ("serving", "supervisor", "fleet"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

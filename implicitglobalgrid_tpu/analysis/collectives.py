@@ -477,6 +477,40 @@ def supervisor_plan_censuses(ctx: Context):
 register_census_provider(supervisor_plan_censuses)
 
 
+def fleet_plan_censuses(ctx: Context):
+    """A pool's ranks' in-band fleet-directive schedule per simulated rank.
+
+    `fleet.policy.fleet_plan` is the single source of the collective
+    schedule a fleet action implies INSIDE the affected pool (the adopt/
+    replay control broadcast for respawn and spill, the config-directive
+    broadcast for the canary verdicts, the drain broadcast for retire —
+    and NOTHING for quarantine, which is out-of-band by design); its
+    ``is_root`` parameter exists precisely so this census can prove the
+    schedule ignores rank identity, and its ``stale`` (fence) flag must
+    gate all ranks or none — a zombie incarnation where one stale rank
+    skips the broadcast its peers enter is the `_gather_chunked` hang
+    class wearing a fleet hat; the seeded positive fixture in
+    ``tests/test_static_analysis.py`` shows this detector catching
+    exactly that divergence.
+    """
+    from ..fleet.policy import FLEET_ACTIONS, fleet_plan
+
+    for action in FLEET_ACTIONS:
+        for stale in (False, True):
+            yield RankCensus(
+                name=f"host/fleet_plan[action={action},stale={stale}]",
+                sequences={
+                    rank: fleet_plan(
+                        is_root=(rank == 0), action=action, stale=stale
+                    )
+                    for rank in range(4)
+                },
+            )
+
+
+register_census_provider(fleet_plan_censuses)
+
+
 def host_plan_findings(ctx: Context) -> list[Finding]:
     out = []
     for provider in list(CENSUS_PROVIDERS):

@@ -251,6 +251,17 @@ def _make_handler(fd: "FrontDoor"):
                     doc = fd.result_view(rid)
                     if doc is None:
                         self._reply(404, {"error": f"unknown request {rid!r}"})
+                    elif doc.get("status") == "expired":
+                        # pruned by IGG_RESULT_KEEP / IGG_RESULT_TTL_S:
+                        # Gone, with the knobs named so the client knows
+                        # which retention bound to raise
+                        _telemetry.counter("frontdoor.results_expired").inc()
+                        self._reply(410, {
+                            "error": f"result {rid!r} expired",
+                            "status": "expired",
+                            "detail": "pruned under IGG_RESULT_KEEP/"
+                                      "IGG_RESULT_TTL_S retention",
+                        })
                     else:
                         self._reply(200, doc)
                 elif path == "/v1/status":
@@ -397,6 +408,11 @@ class FrontDoor:
         self._requests: dict[str, dict] = {}
         self._next_request = 0
         self._seen_results: set[int] = set()
+        # Bounded result retention (ISSUE 16 satellite): request ids are
+        # monotonic, so one integer horizon distinguishes "expired under
+        # IGG_RESULT_KEEP / IGG_RESULT_TTL_S" (structured 410) from
+        # "never existed" (404) without keeping a tombstone per request.
+        self._expired_before = 0
         self._shutdown = False
         self._refusing: str | None = None  # "resizing": reject all submits
         self._drain_target: dict | None = None
@@ -599,6 +615,14 @@ class FrontDoor:
         with self._lock:
             rec = self._requests.get(rid)
             if rec is None:
+                try:
+                    n = int(rid.lstrip("r"))
+                except ValueError:
+                    return None
+                if rid.startswith("r") and n < self._expired_before:
+                    # pruned under the retention knobs: a structured 410,
+                    # distinct from "never existed"
+                    return {"request_id": rid, "status": "expired"}
                 return None
             if rec["done"] is not None:
                 return {"request_id": rid, "status": "done", **rec["done"]}
@@ -777,6 +801,10 @@ class FrontDoor:
             digest = None
             if self.digest_results and res.state is not None:
                 digest = state_digest(res.state)
+            # Every rank consumed the result (the digest is the read):
+            # under the retention knobs the pool may now prune the member
+            # state at the next round end, uniformly across ranks.
+            self.loop.mark_consumed(member)
             if self.rank != 0:
                 continue
             with self._lock:
@@ -788,6 +816,7 @@ class FrontDoor:
             if rec is None:
                 continue
             latency = time.time() - rec["submitted_ts"]
+            rec["done_ts"] = time.time()
             rec["done"] = {
                 "result": res.status,
                 "steps": res.steps,
@@ -802,6 +831,58 @@ class FrontDoor:
                 "frontdoor.complete", request=rec["id"], member=member,
                 tenant=rec["tenant"], result=res.status, steps=res.steps,
                 latency_s=round(latency, 6),
+            )
+        # The loop prunes consumed member states at round end; mirror the
+        # bound here so a request flood cannot grow the door either —
+        # member ids never repeat, so the intersection is monotone-safe.
+        self._seen_results &= set(self.loop.results)
+        if self.rank == 0:
+            self._prune_requests()
+
+    def _prune_requests(self) -> None:
+        """Expire DONE ledger records under the retention knobs (rank 0).
+
+        Same bounds as `ServingLoop._prune_results` — ``IGG_RESULT_KEEP``
+        keeps the newest N done records, ``IGG_RESULT_TTL_S`` drops done
+        records older than the bound — and the same invariant: a record
+        nobody could still need (done = the result has been delivered into
+        the ledger) is the only thing ever dropped; pending/accepted
+        records are immortal until they complete.  Expired rids advance
+        `_expired_before`, so a late fetch gets a structured 410 instead
+        of a lying 404.
+        """
+        keep = _config.result_keep_env() or 0
+        ttl = _config.result_ttl_env()
+        if not keep and ttl is None:
+            return
+        with self._lock:
+            done = sorted(
+                (r for r in self._requests.values() if r["done"] is not None),
+                key=lambda r: r["id"],
+            )
+            doomed = []
+            if ttl is not None:
+                now = time.time()
+                doomed = [
+                    r for r in done if now - r.get("done_ts", now) > ttl
+                ]
+            if keep:
+                fresh = [r for r in done if r not in doomed]
+                if len(fresh) > keep:
+                    doomed += fresh[:-keep]
+            for rec in doomed:
+                del self._requests[rec["id"]]
+                self._expired_before = max(
+                    self._expired_before, int(rec["id"][1:]) + 1
+                )
+        if doomed:
+            _telemetry.counter("frontdoor.requests_pruned_total").inc(
+                len(doomed)
+            )
+            _telemetry.event(
+                "frontdoor.requests_pruned",
+                requests=[r["id"] for r in doomed],
+                horizon=self._expired_before,
             )
 
     def serve_rounds(self, max_rounds: int | None = None, *,
@@ -846,6 +927,7 @@ class FrontDoor:
         with self._lock:
             return {
                 "next_request": self._next_request,
+                "expired_before": self._expired_before,
                 "requests": {
                     rid: {
                         "tenant": r["tenant"], "params": r["params"],
@@ -1016,6 +1098,10 @@ class FrontDoor:
             with self._lock:
                 self._next_request = max(
                     self._next_request, int(fd_meta.get("next_request", 0))
+                )
+                self._expired_before = max(
+                    self._expired_before,
+                    int(fd_meta.get("expired_before", 0)),
                 )
                 for rid, rec in requests.items():
                     self._requests[rid] = {

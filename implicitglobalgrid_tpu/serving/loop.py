@@ -221,6 +221,14 @@ class ServingLoop:
         # (member id, request) pairs awaiting a free slot
         self.queue: collections.deque[tuple[int, Request]] = collections.deque()
         self.results: dict[int, MemberResult] = {}
+        # Bounded result retention (ISSUE 16 satellite): members whose
+        # result a consumer has read (`mark_consumed` — the front door's
+        # harvest calls it) become prunable; `_prune_results` applies the
+        # IGG_RESULT_KEEP depth / IGG_RESULT_TTL_S age bound at each round
+        # end.  Unconsumed results are never pruned — a retention knob
+        # must not lose a result nobody has read yet.
+        self._consumed: set[int] = set()
+        self._result_ts: dict[int, float] = {}
         self.rounds = 0
         # Graceful drain (ISSUE 12, `serving.frontdoor`): when set, slots
         # with index >= drain_above are RETIRING — `_admit_from_queue`
@@ -432,6 +440,7 @@ class ServingLoop:
             member=slot.member, tenant=slot.tenant, status=status,
             steps=slot.steps, state=state, residual=residual,
         )
+        self._result_ts[slot.member] = time.monotonic()
         _telemetry.counter("serving.retired_total").inc()
         etype = {
             "completed": "serving.retire",
@@ -451,6 +460,56 @@ class ServingLoop:
         self._state = _batched.set_member_state(self._state, self._blank, k)
         self.slots[k] = _Slot()
         self._publish_gauges()
+        self._maybe_disarm_convergence()
+
+    def mark_consumed(self, member: int) -> None:
+        """Declare ``member``'s result read: it becomes prunable under the
+        ``IGG_RESULT_KEEP`` / ``IGG_RESULT_TTL_S`` retention bounds.  The
+        front door's harvest calls this per retirement; a standalone
+        consumer that wants a bounded pool opts in the same way."""
+        if member in self.results:
+            self._consumed.add(member)
+
+    def _prune_results(self) -> None:
+        """Apply the retention bounds to CONSUMED results (round end).
+
+        ``IGG_RESULT_KEEP`` keeps the newest N consumed results (0/unset
+        = keep all, the pre-fleet behavior); ``IGG_RESULT_TTL_S`` drops a
+        consumed result older than the bound regardless of the depth.
+        Read per prune, like the other resilience knobs.  A member's full
+        field state is the payload here — on a long-lived pool this dict
+        IS the per-request memory leak the bounds close.
+        """
+        keep = _config.result_keep_env() or 0
+        ttl = _config.result_ttl_env()
+        if not keep and ttl is None:
+            return
+        consumed = sorted(m for m in self.results if m in self._consumed)
+        doomed: list[int] = []
+        if ttl is not None:
+            now = time.monotonic()
+            doomed += [
+                m for m in consumed
+                if now - self._result_ts.get(m, now) > ttl
+            ]
+        if keep:
+            fresh = [m for m in consumed if m not in set(doomed)]
+            if len(fresh) > keep:
+                doomed += fresh[:-keep]
+        for m in doomed:
+            del self.results[m]
+            self._consumed.discard(m)
+            self._result_ts.pop(m, None)
+        if doomed:
+            _telemetry.counter("serving.results_pruned_total").inc(
+                len(doomed)
+            )
+            _telemetry.event(
+                "serving.results_pruned", members=doomed,
+                kept=len(self.results),
+            )
+
+    def _maybe_disarm_convergence(self) -> None:
         if self._residual_fn is not None and not any(
             s.active and s.tol is not None for s in self.slots
         ):
@@ -562,6 +621,7 @@ class ServingLoop:
             ):
                 self._save_checkpoint()
             self._admit_from_queue()
+            self._prune_results()
 
     def _guard(self, mask: np.ndarray) -> None:
         if self.guard_policy == "off":

@@ -75,15 +75,26 @@ def fence_dir() -> str | None:
 
 def authoritative_generation(directory: str | None = None) -> int | None:
     """The supervisor-published current token, or None when no fence file
-    is readable (no supervisor, or a pre-fencing run directory)."""
+    is readable (no supervisor, or a pre-fencing run directory).
+
+    A fence file that is *present but unparseable* also reads as None —
+    refusing every write over a torn file would wedge the run — but that
+    state silently disarms zombie refusal, so it lands on the timeline as
+    a ``fence.corrupt`` event (+ ``fence.corrupt_total``) instead of
+    passing for "no supervisor"."""
     directory = directory if directory is not None else fence_dir()
     if not directory:
         return None
+    path = os.path.join(directory, GENERATION_FILE)
     try:
-        with open(os.path.join(directory, GENERATION_FILE)) as f:
+        with open(path) as f:
             doc = json.load(f)
         return int(doc["generation"])
+    except FileNotFoundError:
+        return None
     except (OSError, ValueError, KeyError, TypeError):
+        _telemetry.counter("fence.corrupt_total").inc()
+        _telemetry.event("fence.corrupt", path=path)
         return None
 
 

@@ -176,6 +176,48 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           a structured 413 before the handler buffers it —
                           the slow-loris/oversize hardening of
                           `serving.frontdoor` (docs/serving.md)
+``IGG_RESULT_KEEP``       retired-result retention depth of the serving
+                          loop (int >= 0; 0/unset = keep every result,
+                          the pre-fleet behavior): after each round, prune
+                          CONSUMED results (harvested by a front door)
+                          beyond the newest N — `serving.ServingLoop`
+                          (docs/serving.md, "bounded result retention")
+``IGG_RESULT_TTL_S``      age bound in seconds on retired results (number
+                          > 0; unset = no TTL): a consumed result older
+                          than this is pruned at round end regardless of
+                          ``IGG_RESULT_KEEP``.  A pruned result's fetch
+                          returns a structured 410 (``results_expired``)
+``IGG_FLEET_PORT``        fleet router public port (`fleet.router`,
+                          docs/serving.md): 0/unset = bind an ephemeral
+                          port (published via a ``fleet.json`` endpoint
+                          file under ``IGG_TELEMETRY_DIR``); N > 0 binds
+                          exactly N
+``IGG_FLEET_POLL_S``      fleet controller liveness/health polling cadence
+                          in seconds (number > 0, default 0.5;
+                          `fleet.controller.FleetController`)
+``IGG_FLEET_RESPAWN_LIMIT``  in-place pool respawns per continuous failure
+                          streak before the fleet policy quarantines the
+                          pool's device subset (int >= 0, default 2;
+                          `fleet.policy.FleetPolicy`)
+``IGG_FLEET_SCRAPE_RETRIES``  per-endpoint retry budget of the fleet/router
+                          health scrapes and of ``scripts/igg_top.py``
+                          (int >= 0, default 2): a scrape is retried with
+                          exponential backoff before the endpoint is
+                          marked ``UNREACHABLE``
+``IGG_FLEET_SPILL_QUEUE`` hot-pool spill threshold (int >= 1; unset =
+                          spill off): a pool whose scraped queue depth
+                          sits at/above it makes the policy spawn a fresh
+                          spill pool instead of resizing the live one
+``IGG_FLEET_IDLE_RETIRE`` consecutive idle observations (queue 0, no
+                          active members) before a spilled pool retires
+                          (int >= 1; unset = pools never retire)
+``IGG_FLEET_CANARY_STREAK``  consecutive healthy canary observations before
+                          the candidate config auto-promotes fleet-wide
+                          (int >= 1, default 3; `fleet.canary`)
+``IGG_FLEET_CANARY_P99_S``  canary SLO breach threshold on the canary
+                          pool's rolling ``slo.serving.round_seconds.p99``
+                          window in seconds (number > 0; unset = only
+                          active CRITICAL alerts breach the canary)
 ``IGG_GENERATION``        this incarnation's generation token (int >= 0;
                           unset = unfenced).  Set by the run supervisor
                           identically on every rank of one incarnation;
@@ -590,6 +632,69 @@ def serve_max_body_env() -> int | None:
     """``IGG_SERVE_MAX_BODY``: front-door request-body bound in bytes
     (>= 1; unset = the 1 MiB default, `serving.frontdoor.MAX_BODY_DEFAULT`)."""
     return _int_env("IGG_SERVE_MAX_BODY", minimum=1)
+
+
+def result_keep_env() -> int | None:
+    """``IGG_RESULT_KEEP``: retired-result retention depth (>= 0;
+    0/unset = keep every result — the pre-fleet behavior)."""
+    return _int_env("IGG_RESULT_KEEP", minimum=0)
+
+
+def result_ttl_env() -> float | None:
+    """``IGG_RESULT_TTL_S``: age bound in seconds on consumed results
+    (> 0; unset = no TTL)."""
+    return _float_env("IGG_RESULT_TTL_S", exclusive_minimum=0)
+
+
+# -- Fleet knobs (read per construction, host-side; docs/serving.md) ----------
+
+
+def fleet_port_env() -> int | None:
+    """``IGG_FLEET_PORT``: fleet router public port (>= 0; 0 = ephemeral).
+    ``None`` = unset — `fleet.router.FleetRouter` falls back to 0."""
+    return _int_env("IGG_FLEET_PORT", minimum=0)
+
+
+def fleet_poll_env() -> float | None:
+    """``IGG_FLEET_POLL_S``: fleet controller polling cadence in seconds
+    (> 0, default 0.5)."""
+    return _float_env("IGG_FLEET_POLL_S", exclusive_minimum=0)
+
+
+def fleet_respawn_limit_env() -> int | None:
+    """``IGG_FLEET_RESPAWN_LIMIT``: pool respawns per failure streak before
+    the policy quarantines the pool's device subset (>= 0, default 2)."""
+    return _int_env("IGG_FLEET_RESPAWN_LIMIT", minimum=0)
+
+
+def fleet_scrape_retries_env() -> int | None:
+    """``IGG_FLEET_SCRAPE_RETRIES``: per-endpoint health-scrape retry budget
+    (>= 0, default 2) before the endpoint is marked ``UNREACHABLE``."""
+    return _int_env("IGG_FLEET_SCRAPE_RETRIES", minimum=0)
+
+
+def fleet_spill_queue_env() -> int | None:
+    """``IGG_FLEET_SPILL_QUEUE``: hot-pool queue depth that makes the policy
+    spawn a spill pool (>= 1; unset = spill off)."""
+    return _int_env("IGG_FLEET_SPILL_QUEUE", minimum=1)
+
+
+def fleet_idle_retire_env() -> int | None:
+    """``IGG_FLEET_IDLE_RETIRE``: consecutive idle observations before a
+    spilled pool retires (>= 1; unset = pools never retire)."""
+    return _int_env("IGG_FLEET_IDLE_RETIRE", minimum=1)
+
+
+def fleet_canary_streak_env() -> int | None:
+    """``IGG_FLEET_CANARY_STREAK``: consecutive healthy canary observations
+    before auto-promote (>= 1, default 3)."""
+    return _int_env("IGG_FLEET_CANARY_STREAK", minimum=1)
+
+
+def fleet_canary_p99_env() -> float | None:
+    """``IGG_FLEET_CANARY_P99_S``: canary round-p99 breach threshold in
+    seconds (> 0; unset = alerts-only breach detection)."""
+    return _float_env("IGG_FLEET_CANARY_P99_S", exclusive_minimum=0)
 
 
 # -- Supervisor / generation-fencing knobs (docs/robustness.md) ---------------

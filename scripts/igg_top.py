@@ -17,7 +17,12 @@ merge::
 ``--dir`` reads the ``liveplane.p<rank>.json`` endpoint files each rank
 writes into ``IGG_TELEMETRY_DIR`` when it binds an ephemeral port — the
 discovery channel for port-0 runs (the soak ``live_plane`` scenario uses
-exactly this).  Exit codes: 0 all endpoints scraped, 1 any endpoint
+exactly this).  A scrape retries with exponential backoff (``--retries``,
+default ``IGG_FLEET_SCRAPE_RETRIES`` or 2 — one transient accept-queue
+hiccup on a busy rank must not paint it dead) before the rank is declared
+``UNREACHABLE``; unreachable ranks get an explicit table row, not just a
+stderr line, so a fleet operator sees the hole in the screen they are
+actually watching.  Exit codes: 0 all endpoints scraped, 1 any endpoint
 unreachable, 2 bad usage.
 """
 
@@ -38,6 +43,9 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 SCRAPE_TIMEOUT_S = 3.0
+DEFAULT_RETRIES = 2
+RETRY_BACKOFF_S = 0.25
+UNREACHABLE = "UNREACHABLE"
 
 _SAMPLE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
 
@@ -76,17 +84,37 @@ def discover_endpoints(args) -> list[str]:
     return endpoints
 
 
-def scrape(endpoint: str) -> dict:
-    """One rank's ``{health, metrics}`` (raises on an unreachable rank)."""
-    with urllib.request.urlopen(
-        f"http://{endpoint}/healthz", timeout=SCRAPE_TIMEOUT_S
-    ) as r:
-        health = json.load(r)
-    with urllib.request.urlopen(
-        f"http://{endpoint}/metrics", timeout=SCRAPE_TIMEOUT_S
-    ) as r:
-        metrics = r.read().decode()
-    return {"endpoint": endpoint, "health": health, "metrics": metrics}
+def scrape(endpoint: str, *, retries: int | None = None,
+           backoff_s: float = RETRY_BACKOFF_S) -> dict:
+    """One rank's ``{health, metrics}``.
+
+    Retries ``retries`` times with exponential backoff (``backoff_s``,
+    ``2*backoff_s``, ...) before re-raising — a rank mid-GC or with a
+    momentarily full accept queue is busy, not dead.  ``retries=None``
+    reads ``IGG_FLEET_SCRAPE_RETRIES`` (shared with the fleet router's
+    health scraper) and falls back to ``DEFAULT_RETRIES``.
+    """
+    if retries is None:
+        raw = os.environ.get("IGG_FLEET_SCRAPE_RETRIES", "")
+        retries = int(raw) if raw.strip() else DEFAULT_RETRIES
+    last: Exception | None = None
+    for attempt in range(max(0, retries) + 1):
+        if attempt:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            with urllib.request.urlopen(
+                f"http://{endpoint}/healthz", timeout=SCRAPE_TIMEOUT_S
+            ) as r:
+                health = json.load(r)
+            with urllib.request.urlopen(
+                f"http://{endpoint}/metrics", timeout=SCRAPE_TIMEOUT_S
+            ) as r:
+                metrics = r.read().decode()
+            return {"endpoint": endpoint, "health": health,
+                    "metrics": metrics}
+        except Exception as e:  # noqa: BLE001 — any failure is retryable
+            last = e
+    raise last
 
 
 # ---------------------------------------------------------------------------
@@ -225,6 +253,16 @@ def render_table(rows: list[dict]) -> str:
     )
     lines = [head, "-" * len(head)]
     for r in rows:
+        if r["ok"] == UNREACHABLE:
+            # explicit row state: the hole in the fleet stays on the
+            # screen the operator is watching, aligned with its rank
+            lines.append(
+                f"{r['rank']:>4} {'DOWN':>4} "
+                + " ".join(["-".rjust(w) for w in (8, 8, 9, 9, 9, 6, 6,
+                                                   7, 8, 8, 10)])
+                + f"  {UNREACHABLE} {r['alerts']}"
+            )
+            continue
         lines.append(
             f"{r['rank']:>4} {('ok' if r['ok'] else 'ALRT'):>4} "
             f"{r['step'] if r['step'] is not None else '-':>8} "
@@ -247,13 +285,14 @@ def render_table(rows: list[dict]) -> str:
 # ---------------------------------------------------------------------------
 
 
-def scrape_cluster(endpoints: list[str]) -> tuple[dict, list[str]]:
+def scrape_cluster(endpoints: list[str], *,
+                   retries: int | None = None) -> tuple[dict, list[str]]:
     """``({rank: scrape result}, [unreachable endpoint messages])``."""
     by_rank: dict[int, dict] = {}
     errors: list[str] = []
     for i, ep in enumerate(endpoints):
         try:
-            res = scrape(ep)
+            res = scrape(ep, retries=retries)
         except Exception as e:
             errors.append(f"{ep}: {type(e).__name__}: {e}")
             continue
@@ -263,9 +302,18 @@ def scrape_cluster(endpoints: list[str]) -> tuple[dict, list[str]]:
 
 
 def one_view(args, endpoints: list[str]) -> int:
-    by_rank, errors = scrape_cluster(endpoints)
+    by_rank, errors = scrape_cluster(
+        endpoints, retries=getattr(args, "retries", None)
+    )
     healths = {r: res["health"] for r, res in by_rank.items()}
     rows = summary_rows(healths)
+    for msg in errors:
+        rows.append({
+            "rank": "?", "ok": UNREACHABLE, "coords": None, "step": None,
+            "age_s": None, "p50_ms": None, "p99_ms": None, "teff_gbs": None,
+            "skew": None, "queue": None, "members": None, "rnd_p50_ms": None,
+            "rnd_p99_ms": None, "reject": None, "alerts": msg,
+        })
     print(
         f"igg_top — {len(by_rank)}/{len(endpoints)} rank(s) at "
         f"{time.strftime('%H:%M:%S')}"
@@ -296,6 +344,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", help="telemetry dir holding liveplane.p*.json")
     ap.add_argument("--watch", type=float, metavar="SECONDS",
                     help="refresh the view every SECONDS until interrupted")
+    ap.add_argument("--retries", type=int, default=None, metavar="N",
+                    help="scrape retries with exponential backoff before an "
+                         "endpoint is declared UNREACHABLE (default: "
+                         "IGG_FLEET_SCRAPE_RETRIES or 2)")
     ap.add_argument("--prom", help="write the merged rank-labeled exposition")
     ap.add_argument("--json", action="store_true",
                     help="also print the cluster health view as one JSON line")

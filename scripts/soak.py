@@ -103,11 +103,14 @@ lives in the package, the drills keep only their load generators and
 acceptance checks.
 
 ``--quick`` runs the ``elastic_failover`` drill, the ``serving`` smoke,
-the ``live_plane`` drill, the ``frontdoor`` drill and the ``chaos`` storm
-at small size — the fast smoke path (registered next to the tier-1
-command in docs/testing.md).  Scenarios can also be named positionally:
+the ``live_plane`` drill, the ``frontdoor`` drill, the ``chaos`` storm
+and the ``fleet`` drill (multi-pool failure domains behind one
+health-routed door + SLO-gated canary rollout, ISSUE 16) at small size —
+the fast smoke path (registered next to the tier-1 command in
+docs/testing.md).  Scenarios can also be named positionally:
 ``python scripts/soak.py chaos --quick`` runs just the chaos drill at
-quick sizing.
+quick sizing; ``--list`` prints every scenario with a one-line
+description.
 """
 
 from __future__ import annotations
@@ -125,7 +128,18 @@ CRASH_STATUS = 17   # FaultInjector.CRASH_STATUS
 RESIZE_STATUS = 19  # serving.frontdoor.RESIZE_STATUS
 SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash",
              "elastic_failover", "serving", "live_plane", "frontdoor",
-             "chaos")
+             "chaos", "fleet")
+SCENARIO_DESCRIPTIONS = {
+    "init_flake": "transient init failure -> bounded retry, result == baseline",
+    "halo_corrupt": "injected halo corruption -> guard trip + checkpoint rollback",
+    "worker_crash": "mid-run crash -> restart resumes from checkpoint, bit-identical",
+    "elastic_failover": "supervised crash -> corrupt-generation fallback -> shrunk-topology restart",
+    "serving": "batched serving loop smoke: mid-flight admit/retire, convergence masking",
+    "live_plane": "mid-run endpoint scrape + stall alert through the live plane",
+    "frontdoor": "HTTP load + stall backpressure + elastic scale-up/down, digests == oracle",
+    "chaos": "seeded multi-fault storm through the self-healing supervisor",
+    "fleet": "chaos-killed pool re-routed behind one door + SLO-gated canary rollout",
+}
 
 
 def _free_port() -> int:
@@ -503,6 +517,53 @@ def child_frontdoor_oracle(args) -> int:
         _json.dump(digests, f)
     igg.finalize_global_grid()
     print("SOAK FRONTDOOR ORACLE OK", flush=True)
+    return 0
+
+
+def child_fleet_pool_main(args) -> int:
+    """One fleet pool: a single-process `ServingLoop` behind its own
+    `FrontDoor`, spawned/fenced/killed by the fleet controller (the
+    ``fleet`` drill).  ``--round-sleep S`` doctors every serving round S
+    seconds slower INSIDE the measured section, so the rolling
+    ``serving.round_seconds`` p99 honestly reports the slowness — the
+    canary-rollback leg's "bad config".  Exits 0 on the broadcast
+    shutdown; the controller's SIGKILL is the other way out."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    import time as _time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import FrontDoor, ServingLoop
+    from implicitglobalgrid_tpu.utils import resilience
+
+    resilience.arm_watchdog(max(30, args.timeout - 40), exit=True)
+    # the same grid as the oracle child: digest bit-identity is the point
+    nxyz = (2 * args.nx - 2, args.nx, args.nx)
+    igg.init_global_grid(*nxyz, quiet=True)
+    _, params = diffusion3d.setup(*nxyz, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=args.capacity,
+                      steps_per_round=1)
+    if args.round_sleep > 0:
+        step = loop._step
+
+        def doctored(*state):
+            _time.sleep(args.round_sleep)
+            return step(*state)
+
+        loop._step = doctored
+    fd = FrontDoor(loop)
+    outcome = fd.serve_rounds(idle_sleep=0.02)
+    fd.close()
+    igg.finalize_global_grid()
+    print(f"SOAK FLEET POOL {outcome}", flush=True)
     return 0
 
 
@@ -1659,6 +1720,332 @@ def supervise_chaos(args) -> bool:
     )
 
 
+def _dump_fleet_logs(fleet_dir: str) -> None:
+    import glob as _glob
+
+    for path in sorted(_glob.glob(os.path.join(fleet_dir, "*", "*.log"))):
+        try:
+            with open(path) as f:
+                tail = f.read()[-2000:]
+        except OSError:
+            continue
+        print(f"---- {path} ----\n{tail}", file=sys.stderr)
+
+
+def supervise_fleet(args) -> bool:
+    """The fleet drill (ISSUE 16, docs/serving.md "The fleet tier"): two
+    live single-process pools behind ONE `FleetRouter`, owned by a
+    `FleetController` in THIS process.  Legs:
+
+    1. bursty multi-tenant traffic; one pool chaos-SIGKILLed with a long
+       job in flight — every request (including submits fired during the
+       outage) completes with digests bit-identical to the undisturbed
+       oracle, zero failed requests, the ``fleet.detect`` →
+       ``fleet.reroute`` → ``fleet.recovered`` order verified from the
+       orchestrator's events.jsonl and the respawned pool's per-pool log
+       carrying the BUMPED generation;
+    2. a healthy canary serving real traffic promotes after the streak
+       and its config overlay spreads to the seed specs;
+    3. a doctored-slow canary (``--round-sleep``) breaches the round-p99
+       SLO bar and rolls back through quarantine — the bad overlay never
+       spreads.
+    """
+    import json as _json
+    import shutil
+    import time as _time
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu import fleet as flt
+    from implicitglobalgrid_tpu.utils import telemetry as tele
+
+    workdir = args.workdir
+    fleet_dir = os.path.join(workdir, "fleet_run")
+    tele_dir = os.path.join(workdir, "telemetry_fleet")
+    shutil.rmtree(fleet_dir, ignore_errors=True)
+    shutil.rmtree(tele_dir, ignore_errors=True)
+    os.makedirs(tele_dir)
+
+    steps = max(4, args.steps)
+    # request catalog: (tenant, ic_scale, max_steps).  The long job is the
+    # chaos victim's in-flight work — rerouted mid-run, replayed whole on
+    # the survivor; the during-outage pair proves the door never closes.
+    traffic = [("tA", 1.0, steps), ("tB", 1.05, steps), ("tA", 1.1, steps),
+               ("tC", 1.15, steps), ("tB", 1.2, steps)]
+    long_job = ("tA", 1.3, 40 * steps)
+    during_outage = [("tC", 1.05, steps), ("tB", 1.1, steps)]
+    canary_job = ("tA", 1.0, steps)
+    all_specs = sorted({(ic, ms) for _, ic, ms in
+                        traffic + during_outage + [long_job, canary_job]})
+
+    # (0) the undisturbed oracle's digests (fixed 1-process topology)
+    specs_path = os.path.join(workdir, "fleet_specs.json")
+    oracle_out = os.path.join(workdir, "fleet_oracle.json")
+    with open(specs_path, "w") as f:
+        _json.dump([list(s) for s in all_specs], f)
+    proc = _run_child(
+        [sys.executable, os.path.abspath(__file__), "--frontdoor-oracle",
+         "--nx", str(args.nx), "--specs", specs_path, "--out", oracle_out],
+        _elastic_env({}), args.timeout,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        return _report("fleet", False, f"oracle rc={proc.returncode}")
+    with open(oracle_out) as f:
+        oracle = _json.load(f)
+
+    # fleet.* events land in the orchestrator's OWN event log
+    saved_env = {k: os.environ.get(k)
+                 for k in ("IGG_TELEMETRY", "IGG_TELEMETRY_DIR")}
+    os.environ["IGG_TELEMETRY"] = "1"
+    os.environ["IGG_TELEMETRY_DIR"] = tele_dir
+
+    pool_env = {"PYTHONPATH": _elastic_env({})["PYTHONPATH"],
+                "IGG_SERVE_PORT": "0"}
+
+    def pool_spec(name, round_sleep=0.0, env_extra=None):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--fleet-pool-child", "--nx", str(args.nx),
+               "--capacity", "2", "--timeout", str(args.timeout),
+               "--round-sleep", str(round_sleep)]
+        return flt.PoolSpec(
+            name=name,
+            command_for=lambda spec, gen: cmd,
+            workdir=os.path.join(fleet_dir, name),
+            telemetry_dir=os.path.join(fleet_dir, name, "telemetry"),
+            key={"model": "diffusion3d"},
+            devices=f"soak-dev-{name}",
+            env={**pool_env, **(env_extra or {})},
+        )
+
+    router = flt.FleetRouter(port=0)
+    fc = flt.FleetController(
+        [pool_spec("a"), pool_spec("b")],
+        router=router,
+        policy=flt.FleetPolicy(respawn_limit=2, canary_streak=2,
+                               canary_p99_s=0.25),
+        poll_s=0.2,
+    )
+    accepted: dict[str, dict] = {}
+    done: dict[str, dict] = {}
+    failed: list = []
+    client = None
+
+    def _submit(tenant, ic, ms):
+        code, body = client.post("/v1/submit", {
+            "tenant": tenant, "model": "diffusion3d",
+            "params": {"ic_scale": ic, "max_steps": ms},
+        })
+        if code != 202:
+            failed.append((tenant, ic, ms, code, body))
+            return None
+        accepted[body["request_id"]] = {"tenant": tenant, "ic": ic,
+                                        "ms": ms, "pool": body["pool"]}
+        return body
+
+    def _poll_done():
+        for fid in list(accepted):
+            if fid in done:
+                continue
+            try:
+                view = client.get(f"/v1/result/{fid}")
+            except OSError:
+                return
+            if isinstance(view, dict) and view.get("status") == "done":
+                done[fid] = view
+
+    def _fail(msg):
+        _dump_fleet_logs(fleet_dir)
+        return _report("fleet", False, msg)
+
+    try:
+        # -- leg 1: traffic + chaos-killed pool -------------------------
+        fc.launch(wait_s=min(60.0, args.timeout))
+        if sorted(router.pools) != ["a", "b"]:
+            return _fail(f"pools never registered: {sorted(router.pools)}")
+        client = _DoorClient(f"127.0.0.1:{router.port}")
+        for t in traffic:
+            if _submit(*t) is None:
+                return _fail(f"submit refused: {failed}")
+        body = _submit(*long_job)
+        if body is None:
+            return _fail(f"submit refused: {failed}")
+        victim = body["pool"]
+        fc.handles[victim].kill()  # chaos: SIGKILL one whole failure domain
+        # the door stays open THROUGH the outage (failover, not 5xx)
+        for t in during_outage:
+            if _submit(*t) is None:
+                return _fail(f"submit failed during the outage: {failed}")
+        deadline = _time.monotonic() + args.timeout
+        while _time.monotonic() < deadline:
+            fc.poll_once()
+            _poll_done()
+            if len(done) == len(accepted):
+                break
+            _time.sleep(0.1)
+        missing = [f for f in accepted if f not in done]
+        if missing:
+            return _fail(f"{len(missing)} accepted request(s) never "
+                         f"completed after the chaos kill: {missing}")
+        if failed:
+            return _fail(f"failed request(s): {failed}")
+        bad = [fid for fid, meta in accepted.items()
+               if (done[fid].get("digest") or {}).get("fields")
+               != oracle.get(f"{meta['ic']}:{meta['ms']}")]
+        if bad:
+            return _fail(f"digest mismatch vs the undisturbed oracle: {bad}")
+        events = tele.read_events(os.path.join(tele_dir, "events.jsonl"))
+        def _first(etype):
+            for i, e in enumerate(events):
+                if e["type"] == etype and e.get("pool") == victim:
+                    return i
+            return None
+        i_det, i_rr, i_rec = (_first("fleet.detect"),
+                              _first("fleet.reroute"),
+                              _first("fleet.recovered"))
+        if not (i_det is not None and i_rr is not None and i_rec is not None
+                and i_det < i_rr < i_rec):
+            return _fail(f"detect->reroute->recovered order broken: "
+                         f"({i_det}, {i_rr}, {i_rec})")
+        # the respawned incarnation's per-pool log carries the BUMPED gen
+        pool_events = tele.read_events(
+            os.path.join(fleet_dir, victim, "telemetry", "events.jsonl")
+        )
+        gens = {e.get("gen") for e in pool_events if e.get("gen") is not None}
+        if not {0, 1} <= gens:
+            return _fail(f"victim pool log gens {sorted(gens)}: the bumped "
+                         f"generation never reached the per-pool log")
+
+        # -- canary legs ------------------------------------------------
+        from implicitglobalgrid_tpu.fleet.router import pool_health_view
+
+        def _bake_canary(name, tenant, ic, ms):
+            """Drive one canary bake honestly: wait for the pool's door,
+            put REAL traffic through it, wait until its rolling round
+            p99 is a measurement (not an idle pool's silence), and only
+            then let the controller's gate observe.  Returns the rid, or
+            None if the pool never served."""
+            deadline = _time.monotonic() + args.timeout
+            ep = None
+            while _time.monotonic() < deadline and ep is None:
+                if fc.handles[name].poll() is not None:
+                    return None
+                ep = fc.discover_endpoint(name)
+                _time.sleep(0.1)
+            if ep is None:
+                return None
+            rid = None
+            while _time.monotonic() < deadline and rid is None:
+                code, b = _DoorClient(ep).post("/v1/submit", {
+                    "tenant": tenant, "model": "diffusion3d",
+                    "params": {"ic_scale": ic, "max_steps": ms},
+                })
+                if code == 202:
+                    rid = b["request_id"]
+                else:
+                    _time.sleep(0.2)
+            while _time.monotonic() < deadline:
+                view = pool_health_view(flt.scrape_health(ep))
+                if view.get("round_p99_s"):
+                    break
+                _time.sleep(0.2)
+            while (_time.monotonic() < deadline
+                   and fc.canary.state == "baking"):
+                fc.poll_once()
+                _time.sleep(0.2)
+            return rid
+
+        # -- leg 2: healthy canary promotes -----------------------------
+        fc.start_canary(
+            pool_spec("canary-good",
+                      env_extra={"SOAK_CANARY_OVERLAY": "good"}),
+            {"overlay": "good"},
+        )
+        if _bake_canary("canary-good", *canary_job) is None:
+            return _fail("the healthy canary pool never served")
+        if fc.canary.state != "promoted":
+            return _fail(f"healthy canary never promoted "
+                         f"(state={fc.canary.state}, "
+                         f"breach={fc.canary.breach})")
+        if fc.specs["a"].env.get("SOAK_CANARY_OVERLAY") != "good":
+            return _fail("promoted overlay never spread to the seed specs")
+        with open(os.path.join(fleet_dir, "canary-good",
+                               "canary.json")) as f:
+            doc = _json.load(f)
+        if doc["state"] != "promoted":
+            return _fail(f"canary.json says {doc['state']!r}, not promoted")
+
+        # -- leg 3: doctored-slow canary rolls back ---------------------
+        fc.start_canary(
+            pool_spec("canary-bad", round_sleep=0.6,
+                      env_extra={"SOAK_CANARY_OVERLAY": "bad"}),
+            {"overlay": "doctored-slow"},
+        )
+        # the doctored round only SHOWS in the p99 once it runs, so the
+        # helper holds the gate until the slowness is a measurement
+        if _bake_canary("canary-bad", "tCanary", 1.0, steps) is None:
+            return _fail("the doctored canary pool never served")
+        if fc.canary.state != "rolled_back":
+            return _fail(f"doctored canary never rolled back "
+                         f"(state={fc.canary.state})")
+        if (fc.canary.breach or {}).get("kind") != "slo":
+            return _fail(f"expected an slo breach, got {fc.canary.breach}")
+        if not router.pools["canary-bad"]["quarantined"]:
+            return _fail("rolled-back canary not quarantined")
+        if fc.specs["a"].env.get("SOAK_CANARY_OVERLAY") != "good":
+            return _fail("the bad overlay reached the seed specs")
+        with open(os.path.join(fleet_dir, "canary-bad", "canary.json")) as f:
+            doc = _json.load(f)
+        if doc["state"] != "rolled_back" or doc["breach"]["kind"] != "slo":
+            return _fail(f"canary.json verdict wrong: {doc}")
+
+        # the fleet.canary.* order, per pool, from the orchestrator log
+        events = tele.read_events(os.path.join(tele_dir, "events.jsonl"))
+        def _order(pool, *etypes):
+            idx = []
+            for et in etypes:
+                found = [i for i, e in enumerate(events)
+                         if e["type"] == et and e.get("pool") == pool]
+                if not found:
+                    return f"{pool}: no {et}"
+                idx.append(found[0])
+            if idx != sorted(idx):
+                return f"{pool}: {list(zip(etypes, idx))} out of order"
+            return None
+        for problem in (
+            _order("canary-good", "fleet.canary.start",
+                   "fleet.canary.observe", "fleet.canary.promote"),
+            _order("canary-bad", "fleet.canary.start",
+                   "fleet.canary.rollback", "fleet.quarantine"),
+        ):
+            if problem:
+                return _fail(f"canary event order: {problem}")
+    finally:
+        try:
+            fc.shutdown()
+        except Exception as e:  # noqa: BLE001 — teardown must not mask
+            print(f"[soak] fleet shutdown: {e}", file=sys.stderr)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    record = {
+        "requests": len(accepted),
+        "rerouted_pool": victim,
+        "canary": {"promoted": "canary-good", "rolled_back": "canary-bad"},
+    }
+    with open(os.path.join(workdir, "fleet_soak.json"), "w") as f:
+        _json.dump(record, f, indent=1)
+    return _report(
+        "fleet", True,
+        f"{len(accepted)} requests, pool {victim!r} chaos-killed -> "
+        f"detect/reroute/recovered with zero failed requests, all digests "
+        f"== oracle; canary promote + doctored-slow rollback (breach=slo)",
+    )
+
+
 def orchestrate(args) -> int:
     import numpy as np
 
@@ -1670,7 +2057,7 @@ def orchestrate(args) -> int:
     baseline = None
     if any(
         s not in ("elastic_failover", "serving", "live_plane", "frontdoor",
-                  "chaos")
+                  "chaos", "fleet")
         for s in args.scenarios
     ):
         proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
@@ -1696,6 +2083,10 @@ def orchestrate(args) -> int:
             continue
         if scenario == "frontdoor":
             if not supervise_frontdoor(args):
+                failures += 1
+            continue
+        if scenario == "fleet":
+            if not supervise_fleet(args):
                 failures += 1
             continue
         if scenario == "serving":
@@ -1801,8 +2192,13 @@ def main() -> int:
         "the batched-serving loop smoke (mid-flight admit/retire, "
         "per-member convergence masking), the live_plane drill "
         "(mid-run endpoint scrape + stall alert) and the frontdoor drill "
-        "(HTTP load + stall backpressure + elastic scale-up/down) at "
+        "(HTTP load + stall backpressure + elastic scale-up/down) and the "
+        "fleet drill (chaos-killed pool re-routed + canary rollout) at "
         "small size — the CI lane registered in docs/testing.md",
+    )
+    ap.add_argument(
+        "--list", action="store_true",
+        help="list every scenario with a one-line description and exit",
     )
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
@@ -1811,6 +2207,8 @@ def main() -> int:
     ap.add_argument("--live-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--frontdoor-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--frontdoor-oracle", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--fleet-pool-child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--round-sleep", type=float, default=0.0, help=argparse.SUPPRESS)
     ap.add_argument("--capacity", type=int, default=2, help=argparse.SUPPRESS)
     ap.add_argument("--rung", type=int, default=0, help=argparse.SUPPRESS)
     ap.add_argument("--resume", type=int, default=0, help=argparse.SUPPRESS)
@@ -1825,6 +2223,10 @@ def main() -> int:
     ap.add_argument("--expect-resume-step", type=int, default=-1,
                     help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.list:
+        for name in SCENARIOS:
+            print(f"{name:<18} {SCENARIO_DESCRIPTIONS[name]}")
+        return 0
     if args.elastic_child:
         return child_elastic_main(args)
     if args.serving_child:
@@ -1835,6 +2237,8 @@ def main() -> int:
         return child_frontdoor_main(args)
     if args.frontdoor_oracle:
         return child_frontdoor_oracle(args)
+    if args.fleet_pool_child:
+        return child_fleet_pool_main(args)
     if args.child:
         return child_main(args)
     if args.scenario:
@@ -1846,7 +2250,7 @@ def main() -> int:
             args.timeout = min(args.timeout, 300)
     elif args.quick:
         args.scenarios = ["elastic_failover", "serving", "live_plane",
-                          "frontdoor", "chaos"]
+                          "frontdoor", "chaos", "fleet"]
         args.steps = min(args.steps, 6)
         args.timeout = min(args.timeout, 300)
     return orchestrate(args)

@@ -1,0 +1,616 @@
+"""The fleet tier (ISSUE 16; docs/serving.md, docs/robustness.md).
+
+Covers the four fleet pieces at the unit level, with fakes at every I/O
+seam (spawn / transport / scrape hooks — no sockets, no subprocesses):
+
+* `fleet.policy` — the pure pool-incident -> fleet-action verdict
+  (respawn strikes -> quarantine, hot -> spill, idle spilled -> retire)
+  and `fleet_plan`'s rank/fence uniformity;
+* `fleet.router` — deterministic health-keyed routing, submit failover,
+  sticky results, and the epoch zombie guard: a superseded pool's late
+  answer is refused with ``fleet.zombie_result``;
+* `fleet.canary` — the baking -> promoted / rolled_back state machine
+  and the fence-gated `publish_canary_state` (a superseded controller's
+  canary-verdict write is refused, ``fence.rejected``);
+* `fleet.controller` — launch/discovery, the ordered death recovery
+  (``fleet.detect`` -> ``fleet.reroute`` -> ``fleet.recovered`` with the
+  generation fence moving FIRST), strike exhaustion -> device-subset
+  quarantine, and the canary gate driving promote/rollback end to end.
+
+Plus the acceptance fence contract one level down: a superseded POOL
+incarnation's front-door endpoint-file write is refused.  The real
+multi-process legs (chaos-killed pool, bit-identical digests vs an
+oracle) are the soak ``fleet`` drill (``scripts/soak.py fleet --quick``).
+"""
+
+import itertools
+import json
+import os
+import time
+
+import pytest
+
+from implicitglobalgrid_tpu import fleet
+from implicitglobalgrid_tpu.fleet import canary as can_mod
+from implicitglobalgrid_tpu.fleet import controller as ctl_mod
+from implicitglobalgrid_tpu.fleet import policy as pol_mod
+from implicitglobalgrid_tpu.fleet import router as rtr_mod
+from implicitglobalgrid_tpu.supervisor import generation as gen_mod
+from implicitglobalgrid_tpu.supervisor.classify import Incident
+from implicitglobalgrid_tpu.utils import telemetry as tele
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("IGG_"):
+            monkeypatch.delenv(k)
+    tele.reset()
+    yield monkeypatch
+    tele.reset()
+
+
+def _events(path):
+    return tele.read_events(path)
+
+
+def _incident(kind, pool="a", **detail):
+    return Incident(kind=kind, ranks=(), rcs=(),
+                    detail={"pool": pool, **detail})
+
+
+def _health(queue=0, members=1, cap=2, p99=0.01, ok=True, alerts=()):
+    return {
+        "ok": ok,
+        "serving": {"queue_depth": queue, "active_members": members,
+                    "capacity": cap},
+        "slo": {"slo.serving.round_seconds": {"p99": p99, "count": 5}},
+        "alerts": {"active": [
+            {"rule": r, "severity": "critical"} for r in alerts
+        ]},
+    }
+
+
+# -- policy: the pure verdict -------------------------------------------------
+
+
+def test_fleet_policy_env_tier_and_validation(clean_env):
+    assert pol_mod.FleetPolicy() == pol_mod.FleetPolicy.from_env()
+    clean_env.setenv("IGG_FLEET_RESPAWN_LIMIT", "5")
+    clean_env.setenv("IGG_FLEET_SPILL_QUEUE", "9")
+    clean_env.setenv("IGG_FLEET_CANARY_P99_S", "0.75")
+    pol = pol_mod.FleetPolicy.from_env(canary_streak=4)
+    assert pol.respawn_limit == 5 and pol.spill_queue == 9
+    assert pol.canary_p99_s == 0.75 and pol.canary_streak == 4
+    assert pol.idle_retire is None
+    for bad in (
+        {"respawn_limit": -1}, {"spill_queue": 0}, {"idle_retire": 0},
+        {"canary_streak": 0}, {"canary_p99_s": 0.0},
+    ):
+        with pytest.raises(ValueError):
+            pol_mod.FleetPolicy(**bad)
+
+
+def test_decide_pool_respawns_then_quarantines_the_device_subset():
+    policy = pol_mod.FleetPolicy(respawn_limit=2)
+    state = pol_mod.FleetState()
+    for used in (1, 2):
+        d = fleet.decide_pool(
+            _incident("died", devices="devA"), state, policy
+        )
+        assert d.action == "respawn" and d.pool == "a"
+        assert f"{used}/2" in d.reason
+        state.apply(d)
+    d = fleet.decide_pool(_incident("wedged", devices="devA"), state, policy)
+    assert d.action == "quarantine" and d.quarantined == ("devA",)
+    state.apply(d)
+    assert "devA" in state.quarantined_devices
+    # a healthy stretch resets the strike streak
+    state.apply(pol_mod.FleetDecision(action="none", pool="a", reason="ok"))
+    assert fleet.decide_pool(
+        _incident("died"), state, policy
+    ).action == "respawn"
+    # the verdict is pure: no pool name -> explicit error, not a guess
+    with pytest.raises(ValueError, match="pool"):
+        fleet.decide_pool(
+            Incident(kind="died", ranks=(), rcs=(), detail={}),
+            state, policy,
+        )
+
+
+def test_decide_pool_hot_spills_and_idle_spilled_pool_retires():
+    state = pol_mod.FleetState()
+    spill = pol_mod.FleetPolicy(spill_queue=4, idle_retire=2)
+    assert fleet.decide_pool(
+        _incident("hot", queue_depth=6), state, spill
+    ).action == "spill"
+    # spill off -> hot is tolerated
+    assert fleet.decide_pool(
+        _incident("hot"), state, pol_mod.FleetPolicy()
+    ).action == "none"
+    # idle retires only SPILLED pools, only past the streak bar
+    for _ in range(2):
+        state.record_health("a", queue_depth=0, active_members=0)
+    assert fleet.decide_pool(
+        _incident("idle"), state, spill, spilled=False
+    ).action == "none"
+    assert fleet.decide_pool(
+        _incident("idle"), state, spill, spilled=True
+    ).action == "retire"
+    # one busy observation resets the idle streak
+    state.record_health("a", queue_depth=1, active_members=1)
+    assert fleet.decide_pool(
+        _incident("idle"), state, spill, spilled=True
+    ).action == "none"
+
+
+def test_fleet_plan_rank_and_fence_uniform():
+    for action in pol_mod.FLEET_ACTIONS:
+        assert fleet.fleet_plan(True, action, False) == fleet.fleet_plan(
+            False, action, False
+        )
+        # a fenced incarnation refuses the directive on EVERY rank together
+        assert fleet.fleet_plan(True, action, True) == ()
+    assert fleet.fleet_plan(True, "respawn", False) == (
+        ("broadcast_control", "adopt-replay"),
+    )
+    assert fleet.fleet_plan(False, "quarantine", False) == ()
+
+
+# -- router: fakes at the transport/scrape seam -------------------------------
+
+
+class _FakeDoor:
+    """One pool front door behind the router's transport hook."""
+
+    def __init__(self):
+        self.next_rid = 0
+        self.submits = []
+        self.results = {}
+        self.dead = False
+
+
+def _fake_fleet(healths):
+    """(router, doors): a serve=False router whose transport and scrape
+    run against in-process fakes."""
+    doors = {}
+
+    def transport(endpoint, method, path, doc):
+        door = doors.setdefault(endpoint, _FakeDoor())
+        if door.dead:
+            return 0, {}
+        if method == "POST" and path == "/v1/submit":
+            rid = f"r{door.next_rid:06d}"
+            door.next_rid += 1
+            door.submits.append((rid, dict(doc)))
+            return 202, {"request_id": rid}
+        if method == "GET" and path.startswith("/v1/result/"):
+            rid = path.rsplit("/", 1)[1]
+            if rid in door.results:
+                return 200, {"status": "done", **door.results[rid]}
+            return 200, {"request_id": rid, "status": "pending"}
+        if method == "POST" and path == "/v1/shutdown":
+            return 200, {}
+        return 404, {}
+
+    router = rtr_mod.FleetRouter(
+        serve=False, transport=transport,
+        scrape=lambda ep: healths.get(ep),
+    )
+    return router, doors
+
+
+def test_choose_pool_is_deterministic_least_loaded_and_key_matched():
+    def cand(name, *, q=0, m=0, p99=0.0, quarantined=False, key=None,
+             unreachable=False):
+        return {
+            "name": name, "key": key or {}, "quarantined": quarantined,
+            "health": rtr_mod.pool_health_view(
+                None if unreachable else _health(queue=q, members=m, p99=p99)
+            ),
+        }
+
+    doc = {"model": "diffusion3d", "tenant": "t"}
+    cands = [
+        cand("c", q=1), cand("b"), cand("a"),
+        cand("quar", quarantined=True), cand("dark", unreachable=True),
+        cand("other", key={"model": "acoustic3d"}),
+    ]
+    # least loaded first; name breaks ties; ineligible never chosen
+    assert rtr_mod.choose_pool(doc, cands) == "a"
+    assert rtr_mod.choose_pool(doc, cands) == "a"  # deterministic
+    assert rtr_mod.choose_pool(
+        doc, [cand("b", q=2, m=2), cand("c", q=2, m=1)]
+    ) == "c"
+    assert rtr_mod.choose_pool(doc, [cand("x", key={"model": "acoustic3d"})]) \
+        is None
+    # size is part of the routing contract when both sides state one
+    sized = [cand("s", key={"model": "diffusion3d", "size": [8, 8, 8]})]
+    assert rtr_mod.choose_pool(dict(doc, size=[8, 8, 8]), sized) == "s"
+    assert rtr_mod.choose_pool(dict(doc, size=[16, 8, 8]), sized) is None
+
+
+def test_router_submit_sticky_result_and_failover(clean_env, tmp_path):
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    healths = {"a:1": _health(queue=0), "b:2": _health(queue=3)}
+    router, doors = _fake_fleet(healths)
+    router.register_pool("a", "a:1", key={"model": "diffusion3d"})
+    router.register_pool("b", "b:2", key={"model": "diffusion3d"})
+    doc = {"tenant": "t", "model": "diffusion3d",
+           "params": {"max_steps": 2}}
+    code, body = router.submit(doc)
+    assert code == 202 and body == {"request_id": "f000000", "pool": "a"}
+    # sticky: the fetch proxies to the owning pool's own rid
+    code, view = router.result("f000000")
+    assert code == 200 and view["status"] == "pending"
+    doors["a:1"].results["r000000"] = {"result": "completed", "steps": 2}
+    code, view = router.result("f000000")
+    assert view["status"] == "done" and view["pool"] == "a"
+    # ...and the done answer is cached (the pool can die after)
+    doors["a:1"].dead = True
+    code, view = router.result("f000000")
+    assert code == 200 and view["result"] == "completed"
+    assert router.result("f999999")[0] == 404
+    # failover: a dark pool costs one attempt, never a failed request
+    code, body = router.submit(doc)
+    assert code == 202 and body["pool"] == "b"
+    events = _events(tmp_path / "events.jsonl")
+    assert [e["pool"] for e in events if e["type"] == "fleet.route"] == \
+        ["a", "b"]
+    assert any(e["type"] == "fleet.pool_unreachable" and e["pool"] == "a"
+               for e in events)
+    counters = tele.snapshot()["counters"]
+    assert counters["fleet.routed_total"] == 2
+    # nobody left -> structured 503, counted
+    doors["b:2"].dead = True
+    code, body = router.submit(doc)
+    assert code == 503 and "tried" in body
+    assert tele.snapshot()["counters"]["fleet.unroutable_total"] == 1
+
+
+def test_router_evacuate_rejects_zombie_pool_late_result(clean_env, tmp_path):
+    """Satellite: a chaos-killed pool's process that outlives its SIGKILL
+    and answers one last time must NOT land its result in the router."""
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    healths = {"a:1": _health(), "b:2": _health(queue=1)}
+    router, doors = _fake_fleet(healths)
+    router.register_pool("a", "a:1")
+    router.register_pool("b", "b:2")
+    code, body = router.submit({"tenant": "t", "params": {"max_steps": 2}})
+    fid = body["request_id"]
+    assert router.routes[fid]["pool"] == "a"
+    moved = router.evacuate("a")
+    assert moved == [fid]
+    route = router.routes[fid]
+    assert route["pool"] == "b" and route["epoch"] == 1
+    # the re-submitted spec reached b verbatim (parameters, never arrays)
+    assert doors["b:2"].submits[-1][1]["params"] == {"max_steps": 2}
+    # the zombie's adoption quotes the OLD (pool, epoch): refused
+    assert not router.adopt_result(fid, "a", 0, {"result": "completed"})
+    assert router.routes[fid]["done"] is None
+    # the CURRENT owner at the current epoch is adopted fine
+    assert router.adopt_result(fid, "b", 1, {"result": "completed"})
+    events = _events(tmp_path / "events.jsonl")
+    reroutes = [e for e in events if e["type"] == "fleet.reroute"]
+    assert reroutes and reroutes[0]["requests"] == [fid]
+    zombies = [e for e in events if e["type"] == "fleet.zombie_result"]
+    assert zombies and zombies[0]["pool"] == "a"
+    assert zombies[0]["owner"] == "b" and zombies[0]["owner_epoch"] == 1
+    counters = tele.snapshot()["counters"]
+    assert counters["fleet.zombie_results_total"] == 1
+    assert counters["fleet.rerouted_total"] == 1
+
+
+# -- canary: the SLO-gated state machine --------------------------------------
+
+
+def test_canary_promotes_after_healthy_streak(clean_env, tmp_path):
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tr = can_mod.CanaryTracker(
+        pool="c", candidate={"overlay": "v2"},
+        policy=pol_mod.FleetPolicy(canary_streak=3, canary_p99_s=1.0),
+    )
+    assert tr.observe(_health(p99=0.2)) == "baking"
+    assert tr.observe(_health(p99=0.2)) == "baking"
+    assert tr.observe(_health(p99=0.2)) == "promoted"
+    assert tr.observe(None) == "promoted"  # terminal states are sticky
+    types = [e["type"] for e in _events(tmp_path / "events.jsonl")]
+    assert types[0] == "fleet.canary.start"
+    assert types.count("fleet.canary.observe") == 3
+    assert types[-1] == "fleet.canary.promote"
+    assert tele.snapshot()["counters"]["fleet.canary.promotions_total"] == 1
+
+
+@pytest.mark.parametrize("health,kind", [
+    (None, "unreachable"),
+    (_health(p99=2.0), "slo"),
+    (_health(ok=False, alerts=("step_stall",)), "alert"),
+])
+def test_canary_rolls_back_on_any_breach(clean_env, tmp_path, health, kind):
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    tr = can_mod.CanaryTracker(
+        pool="c", candidate={"overlay": "v2"},
+        policy=pol_mod.FleetPolicy(canary_streak=2, canary_p99_s=1.0),
+    )
+    assert tr.observe(_health(p99=0.2)) == "baking"
+    assert tr.observe(health) == "rolled_back"
+    assert tr.breach["kind"] == kind
+    assert tr.observe(_health(p99=0.2)) == "rolled_back"  # sticky
+    roll = [e for e in _events(tmp_path / "events.jsonl")
+            if e["type"] == "fleet.canary.rollback"]
+    assert roll and roll[0]["kind"] == kind and roll[0]["observations"] == 2
+    assert tele.snapshot()["counters"]["fleet.canary.rollbacks_total"] == 1
+
+
+def test_superseded_controller_canary_write_refused(clean_env, tmp_path):
+    """Satellite: the zombie-controller half of the fence contract — a
+    superseded incarnation must not flip a canary verdict on disk."""
+    telem, fence, work = (
+        tmp_path / "telem", tmp_path / "fence", tmp_path / "work"
+    )
+    work.mkdir()
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    assert can_mod.publish_canary_state(str(work), {"state": "baking"})
+    gen_mod.publish_generation(2, str(fence))
+    clean_env.setenv("IGG_FENCE_DIR", str(fence))
+    clean_env.setenv("IGG_GENERATION", "1")
+    assert not can_mod.publish_canary_state(
+        str(work), {"state": "rolled_back"}
+    )
+    # the live verdict is untouched
+    doc = json.loads((work / can_mod.CANARY_STATE).read_text())
+    assert doc == {"state": "baking"}
+    rej = [e for e in _events(telem / "events.jsonl")
+           if e["type"] == "fence.rejected"]
+    assert rej and rej[0]["what"] == "fleet.canary"
+    assert tele.snapshot()["counters"]["fence.rejected_total"] == 1
+    # the current incarnation writes fine
+    clean_env.setenv("IGG_GENERATION", "2")
+    assert can_mod.publish_canary_state(str(work), {"state": "promoted"})
+
+
+def test_superseded_pool_endpoint_file_refused(clean_env, tmp_path):
+    """Satellite: the zombie-POOL half — a superseded pool incarnation's
+    front door must not steal the discovery file the fleet controller's
+    replacement pool publishes (`fence.rejected`, no file)."""
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import FrontDoor, ServingLoop
+    from implicitglobalgrid_tpu.serving import frontdoor as fdm
+    from implicitglobalgrid_tpu.utils import liveplane as lp
+
+    telem, fence = tmp_path / "telem", tmp_path / "fence"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    gen_mod.publish_generation(2, str(fence))
+    clean_env.setenv("IGG_FENCE_DIR", str(fence))
+    clean_env.setenv("IGG_GENERATION", "1")
+    igg.init_global_grid(8, 8, 8, quiet=True)
+    _, params = diffusion3d.setup(8, 8, 8, init_grid=False)
+    loop = ServingLoop(diffusion3d, params, capacity=1, steps_per_round=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        assert not (telem / fdm.endpoint_filename(0)).exists()
+        rej = [e for e in _events(telem / "events.jsonl")
+               if e["type"] == "fence.rejected"]
+        assert rej and rej[-1]["what"] == "frontdoor.endpoint"
+    finally:
+        fd.close()
+        lp.reset()
+
+
+# -- controller: fakes at the spawn seam --------------------------------------
+
+
+class _FakeProc:
+    def __init__(self):
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+def _fleet_fixture(tmp_path, *, pools=("a", "b"), policy=None, healths=None):
+    """A controller over fake processes: spawn writes the endpoint file a
+    real pool's front door would, transport/scrape run in-process."""
+    healths = healths if healths is not None else {}
+    procs = {}
+    ports = itertools.count(40001)
+
+    def spawn(argv, env, log_path):
+        tdir = env["IGG_TELEMETRY_DIR"]
+        os.makedirs(tdir, exist_ok=True)
+        port = next(ports)
+        with open(os.path.join(tdir, "frontdoor.p0.json"), "w") as f:
+            json.dump({"rank": 0, "pid": 1, "host": "127.0.0.1",
+                       "port": port, "ts": time.time() + 5.0}, f)
+        proc = _FakeProc()
+        procs[env["IGG_TELEMETRY_DIR"]] = proc
+        procs[f"127.0.0.1:{port}"] = proc
+        return proc
+
+    def scrape(endpoint):
+        if procs.get(endpoint) is not None and procs[endpoint].rc is not None:
+            return None
+        return healths.get(endpoint, _health())
+
+    router, doors = _fake_fleet({})
+    router.scrape = scrape
+    specs = [
+        ctl_mod.PoolSpec(
+            name=name,
+            command_for=lambda spec, gen: ["pool", spec.name, str(gen)],
+            workdir=str(tmp_path / name),
+            telemetry_dir=str(tmp_path / name / "telemetry"),
+            key={"model": "diffusion3d"},
+            devices=f"dev-{name}",
+        )
+        for name in pools
+    ]
+    fc = ctl_mod.FleetController(
+        specs, router=router,
+        policy=policy or pol_mod.FleetPolicy(respawn_limit=2),
+        poll_s=0.01, spawn=spawn, scrape=scrape,
+    )
+    return fc, router, doors, procs
+
+
+def test_controller_launch_discovers_and_registers(clean_env, tmp_path):
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(tmp_path / "fleet-telem"))
+    fc, router, _doors, _procs = _fleet_fixture(tmp_path)
+    fc.launch(wait_s=5.0)
+    assert sorted(router.pools) == ["a", "b"]
+    assert fc.handles["a"].endpoint == "127.0.0.1:40001"
+    # each pool is its own failure domain: its OWN fence dir and token
+    for name in ("a", "b"):
+        assert gen_mod.authoritative_generation(str(tmp_path / name)) == 0
+    events = _events(tmp_path / "fleet-telem" / "events.jsonl")
+    assert [e["type"] for e in events].count("fleet.pool_up") == 2
+
+
+def test_controller_death_recovery_order_and_fence(clean_env, tmp_path):
+    """The drill's event contract at the unit level: detect -> reroute ->
+    recovered, with the authoritative generation bumped BEFORE the
+    replacement spawns and the in-flight route re-homed with zero loss."""
+    telem = tmp_path / "fleet-telem"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    fc, router, doors, procs = _fleet_fixture(tmp_path)
+    fc.launch(wait_s=5.0)
+    code, body = router.submit({"tenant": "t", "params": {"max_steps": 2}})
+    assert code == 202
+    fid, victim = body["request_id"], body["pool"]
+    procs[fc.handles[victim].endpoint].rc = 9  # chaos kill
+    decisions = fc.poll_once()
+    assert [d.action for d in decisions] == ["respawn"]
+    # the route survived onto the OTHER pool at a bumped epoch
+    route = router.routes[fid]
+    assert route["pool"] != victim and route["epoch"] == 1
+    # fence moved first: the dead incarnation (gen 0) is now superseded
+    assert gen_mod.authoritative_generation(str(tmp_path / victim)) == 1
+    assert fc.handles[victim].generation == 1
+    types = [e["type"] for e in _events(telem / "events.jsonl")]
+    assert types.index("fleet.detect") < types.index("fleet.reroute") \
+        < types.index("fleet.recovered")
+    # healthy again -> the strike streak resets on the next sweep
+    assert fc.poll_once() == []
+    assert fc.state.respawns[victim] == 0
+
+
+def test_controller_strike_exhaustion_quarantines_devices(
+    clean_env, tmp_path
+):
+    telem = tmp_path / "fleet-telem"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    fc, router, _doors, procs = _fleet_fixture(
+        tmp_path, policy=pol_mod.FleetPolicy(respawn_limit=0)
+    )
+    fc.launch(wait_s=5.0)
+    procs[fc.handles["a"].endpoint].rc = 7
+    decisions = fc.poll_once()
+    assert [d.action for d in decisions] == ["quarantine"]
+    assert fc.state.quarantined_devices == {"dev-a"}
+    assert router.pools["a"]["quarantined"]
+    # a quarantined pool never routes again
+    code, body = router.submit({"tenant": "t", "params": {"max_steps": 1}})
+    assert code == 202 and body["pool"] == "b"
+    types = [e["type"] for e in _events(telem / "events.jsonl")]
+    assert "fleet.quarantine" in types and "fleet.recovered" not in types
+
+
+def test_controller_canary_promote_spreads_the_overlay(clean_env, tmp_path):
+    telem = tmp_path / "fleet-telem"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    fc, _router, _doors, _procs = _fleet_fixture(
+        tmp_path, pools=("a",),
+        policy=pol_mod.FleetPolicy(canary_streak=2, canary_p99_s=1.0),
+    )
+    fc.launch(wait_s=5.0)
+    spec = ctl_mod.PoolSpec(
+        name="canary",
+        command_for=lambda s, g: ["pool", s.name, str(g)],
+        workdir=str(tmp_path / "canary"),
+        telemetry_dir=str(tmp_path / "canary" / "telemetry"),
+        env={"IGG_TUNE_CACHE": str(tmp_path / "overlay")},
+    )
+    fc.start_canary(spec, {"overlay": "v2"})
+    with pytest.raises(RuntimeError, match="already baking"):
+        fc.start_canary(spec, {"overlay": "v3"})
+    assert fc.poll_once() == [] and fc.canary.state == "baking"
+    assert fc.poll_once() == [] and fc.canary.state == "promoted"
+    # the candidate is fleet-safe: the seed pool inherits the overlay for
+    # its next (re)launch
+    assert fc.specs["a"].env["IGG_TUNE_CACHE"] == str(tmp_path / "overlay")
+    doc = json.loads((tmp_path / "canary" / can_mod.CANARY_STATE).read_text())
+    assert doc["state"] == "promoted" and doc["streak"] == 2
+
+
+def test_controller_canary_breach_rolls_back_through_strikes(
+    clean_env, tmp_path
+):
+    telem = tmp_path / "fleet-telem"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    healths = {}
+    fc, router, _doors, _procs = _fleet_fixture(
+        tmp_path, pools=("a",), healths=healths,
+        policy=pol_mod.FleetPolicy(canary_streak=3, canary_p99_s=0.5),
+    )
+    fc.launch(wait_s=5.0)
+    spec = ctl_mod.PoolSpec(
+        name="canary",
+        command_for=lambda s, g: ["pool", s.name, str(g)],
+        workdir=str(tmp_path / "canary"),
+        telemetry_dir=str(tmp_path / "canary" / "telemetry"),
+        env={"IGG_TUNE_CACHE": "doctored"},
+    )
+    fc.start_canary(spec, {"overlay": "doctored"})
+    fc.poll_once()  # healthy observation: still baking
+    # the doctored config shows up as a round-p99 SLO breach
+    healths[fc.handles["canary"].endpoint] = _health(p99=2.0)
+    fc.poll_once()
+    assert fc.canary.state == "rolled_back"
+    assert fc.canary.breach["kind"] == "slo"
+    # the rollback IS the strike machinery: quarantined, never respawned
+    assert router.pools["canary"]["quarantined"]
+    assert "IGG_TUNE_CACHE" not in fc.specs["a"].env
+    doc = json.loads((tmp_path / "canary" / can_mod.CANARY_STATE).read_text())
+    assert doc["state"] == "rolled_back" and doc["breach"]["kind"] == "slo"
+    types = [e["type"] for e in _events(telem / "events.jsonl")]
+    assert "fleet.canary.rollback" in types and "fleet.quarantine" in types
+    assert types.index("fleet.canary.start") \
+        < types.index("fleet.canary.observe") \
+        < types.index("fleet.canary.rollback")
+    assert "fleet.canary.promote" not in types
+
+
+def test_controller_spill_and_retire_lifecycle(clean_env, tmp_path):
+    telem = tmp_path / "fleet-telem"
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    healths = {}
+    fc, router, _doors, _procs = _fleet_fixture(
+        tmp_path, pools=("a",), healths=healths,
+        policy=pol_mod.FleetPolicy(spill_queue=4, idle_retire=2),
+    )
+    fc.launch(wait_s=5.0)
+    healths[fc.handles["a"].endpoint] = _health(queue=6, members=2)
+    decisions = fc.poll_once()
+    assert [d.action for d in decisions] == ["spill"]
+    spill = next(iter(fc.spilled))
+    assert spill.startswith("a-spill") and spill in fc.handles
+    # the seed pool cools down; the spill pool sits idle past the bar
+    healths[fc.handles["a"].endpoint] = _health(queue=1, members=1)
+    healths[fc.discover_endpoint(spill)] = _health(queue=0, members=0)
+    assert fc.poll_once() == []  # idle streak 1
+    decisions = fc.poll_once()   # idle streak 2 -> retire
+    assert [d.action for d in decisions] == ["retire"]
+    assert spill not in router.pools
+    # the seed pool NEVER retires, however idle
+    healths[fc.handles["a"].endpoint] = _health(queue=0, members=0)
+    for _ in range(4):
+        assert fc.poll_once() == []
+    types = [e["type"] for e in _events(telem / "events.jsonl")]
+    assert "fleet.spill" in types and "fleet.retire" in types

@@ -14,6 +14,7 @@ the soak ``frontdoor`` scenario (`scripts/soak.py --quick`).
 
 import json
 import os
+import time
 import urllib.error
 import urllib.request
 
@@ -45,7 +46,7 @@ def _fresh_state(monkeypatch):
     for knob in ("IGG_TENANT_QUOTA", "IGG_FRONTDOOR_QUEUE_MAX",
                  "IGG_FRONTDOOR_SLO_P99_S", "IGG_AUTOSCALE_QUEUE_HIGH",
                  "IGG_AUTOSCALE_SUSTAIN", "IGG_SERVE_PORT", "IGG_SERVE_HOST",
-                 "IGG_METRICS_PORT"):
+                 "IGG_METRICS_PORT", "IGG_RESULT_KEEP", "IGG_RESULT_TTL_S"):
         monkeypatch.delenv(knob, raising=False)
     tele.reset()
     tracing.reset()
@@ -656,3 +657,72 @@ def test_handler_socket_timeouts_armed():
     handler = fdm._make_handler(object())
     assert handler.timeout == fdm.SOCKET_TIMEOUT_S > 0
     assert lp._Handler.timeout and lp._Handler.timeout > 0
+
+
+def test_result_retention_bounds_a_flood(monkeypatch):
+    """Regression (ISSUE 16): a tenant that floods submits and never
+    fetches must not grow ``loop.results`` / the request ledger without
+    bound.  Flooded-out results answer a structured 410 (distinct from
+    the 404 a never-issued rid gets), and the expiry is COUNTED."""
+    monkeypatch.setenv("IGG_RESULT_KEEP", "4")
+    loop = _pool(capacity=2)
+    fd = FrontDoor(loop, port=0)
+    try:
+        for _ in range(12):
+            code, body, _ = _post(fd.port, "/v1/submit", {
+                "tenant": "t", "model": "diffusion3d",
+                "params": {"max_steps": 1},
+            })
+            assert code == 202
+        fd.serve_rounds(max_rounds=40)
+        assert fd._seen_results <= set(loop.results)  # harvest keeps it tight
+        loop._prune_results()  # the last round's harvest was post-prune
+        assert len(loop.results) <= 4
+        # the newest result still serves complete, digest and all
+        code, view = _get(fd.port, "/v1/result/r000011")
+        assert code == 200 and view["status"] == "done"
+        assert view["result"] == "completed" and "digest" in view
+        # a flooded-out rid is the structured 410, not a 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{fd.port}/v1/result/r000000", timeout=10
+            )
+        assert e.value.code == 410
+        view = json.loads(e.value.read().decode())
+        assert view["status"] == "expired"
+        assert "IGG_RESULT_KEEP" in view["detail"]
+        assert tele.snapshot()["counters"]["frontdoor.results_expired"] >= 1
+        # ...and a rid that never existed is still the honest 404
+        code, view = _get(fd.port, "/v1/result/r999999")
+        assert code == 404
+        # the ledger prune announced itself
+        snap = tele.snapshot()["counters"]
+        assert snap["frontdoor.requests_pruned_total"] >= 8
+        assert snap["serving.results_pruned_total"] >= 8
+    finally:
+        fd.close()
+
+
+def test_unconsumed_results_survive_the_ttl(monkeypatch):
+    """The retention invariant: a result NOBODY has read (no harvest, no
+    digest) is never pruned, however old — a retention knob must not
+    lose an answer before its first read."""
+    monkeypatch.setenv("IGG_RESULT_TTL_S", "0.001")
+    loop = _pool(capacity=2)
+    fd = FrontDoor(loop, port=0)
+    try:
+        for _ in range(2):
+            code, _, _ = _post(fd.port, "/v1/submit", {
+                "tenant": "t", "model": "diffusion3d",
+                "params": {"max_steps": 1},
+            })
+            assert code == 202
+        fd.serve_rounds(max_rounds=6)
+        assert sorted(loop.results) == [0, 1]
+        for m in loop.results:  # age both far past the TTL
+            loop._result_ts[m] = time.monotonic() - 99.0
+        loop._consumed.discard(0)  # ...but declare member 0 unread
+        loop._prune_results()
+        assert 0 in loop.results and 1 not in loop.results
+    finally:
+        fd.close()

@@ -45,6 +45,10 @@ def test_lint_sees_the_known_knobs():
         "IGG_TENANT_QUOTA",
         "IGG_FRONTDOOR_QUEUE_MAX",
         "IGG_AUTOSCALE_SUSTAIN",
+        # the fleet tier (ISSUE 16, docs/serving.md "The fleet tier")
+        "IGG_FLEET_RESPAWN_LIMIT",
+        "IGG_FLEET_CANARY_P99_S",
+        "IGG_RESULT_KEEP",
     ):
         assert knob in refs, f"{knob} vanished from the package scan"
 

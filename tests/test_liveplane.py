@@ -564,3 +564,85 @@ def test_igg_top_scrapes_a_real_server(monkeypatch, tmp_path):
                        "dir": str(tmp_path)})()
     )
     assert eps == [f"127.0.0.1:{port}"]
+
+
+def test_igg_top_scrape_retries_with_backoff_then_succeeds(monkeypatch):
+    """Satellite (ISSUE 16): a rank mid-GC answers on the second try —
+    the scrape retries with exponential backoff instead of declaring a
+    busy rank dead."""
+    igg_top = _igg_top()
+    sleeps = []
+    monkeypatch.setattr(igg_top.time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    class _Resp:
+        def __init__(self, payload):
+            self.payload = payload
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def read(self):
+            return self.payload
+
+    def flaky_urlopen(url, timeout=None):
+        calls["n"] += 1
+        if calls["n"] <= 1:  # the first attempt fails outright
+            raise OSError("connection refused")
+        if url.endswith("/healthz"):
+            return _Resp(b'{"rank": 3}')
+        return _Resp(b"igg_m_steps_total 4\n")
+
+    monkeypatch.setattr(igg_top.urllib.request, "urlopen", flaky_urlopen)
+    res = igg_top.scrape("h:1", retries=3, backoff_s=0.25)
+    assert res["health"]["rank"] == 3 and "igg_m" in res["metrics"]
+    assert sleeps == [0.25]  # one backoff step bought the answer
+
+    # a truly dead endpoint exhausts the budget and re-raises
+    calls["n"] = -10**9
+    sleeps.clear()
+    with pytest.raises(OSError):
+        igg_top.scrape("h:1", retries=3, backoff_s=0.25)
+    assert sleeps == [0.25, 0.5, 1.0]  # exponential, then give up
+
+
+def test_igg_top_retries_default_reads_fleet_env(monkeypatch):
+    igg_top = _igg_top()
+    sleeps = []
+    monkeypatch.setattr(igg_top.time, "sleep", sleeps.append)
+    monkeypatch.setattr(
+        igg_top.urllib.request, "urlopen",
+        lambda url, timeout=None: (_ for _ in ()).throw(OSError("down")),
+    )
+    monkeypatch.setenv("IGG_FLEET_SCRAPE_RETRIES", "0")
+    with pytest.raises(OSError):
+        igg_top.scrape("h:1")
+    assert sleeps == []  # 0 retries: one attempt, no backoff
+    monkeypatch.delenv("IGG_FLEET_SCRAPE_RETRIES")
+    with pytest.raises(OSError):
+        igg_top.scrape("h:1", backoff_s=0.0)
+    assert len(sleeps) == igg_top.DEFAULT_RETRIES
+
+
+def test_igg_top_unreachable_rank_gets_an_explicit_row(capsys):
+    """An unreachable rank is a DOWN row in the table, not a silently
+    shorter table — and the exit code says so."""
+    igg_top = _igg_top()
+    args = type("A", (), {"retries": 0, "prom": None, "json": False})()
+    rc = igg_top.one_view(args, ["127.0.0.1:1"])
+    assert rc == 1
+    out, err = capsys.readouterr()
+    assert "0/1 rank(s)" in out
+    row = [ln for ln in out.splitlines() if igg_top.UNREACHABLE in ln]
+    assert row and "DOWN" in row[0] and "127.0.0.1:1" in row[0]
+    assert igg_top.UNREACHABLE in err
+
+
+def test_igg_top_main_parses_retries_flag():
+    igg_top = _igg_top()
+    # end to end through argparse: a dead endpoint with --retries 0 is
+    # declared UNREACHABLE without a single backoff sleep
+    assert igg_top.main(["127.0.0.1:1", "--retries", "0"]) == 1

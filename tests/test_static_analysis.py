@@ -258,6 +258,42 @@ def test_gather_plan_census_is_clean_and_covers_the_real_plan():
         assert len(census.sequences) >= 1
 
 
+def test_fleet_plan_census_registered_and_clean():
+    """ISSUE 16: the fleet tier's in-band directive schedule joins the
+    same deadlock detector as the gather/tuner/supervisor plans — the
+    REAL `fleet_plan` must be rank- and fence-uniform over every action."""
+    from implicitglobalgrid_tpu.analysis import collectives as C
+    from implicitglobalgrid_tpu.fleet.policy import FLEET_ACTIONS
+
+    assert C.fleet_plan_censuses in C.CENSUS_PROVIDERS
+    censuses = list(C.fleet_plan_censuses(Context()))
+    assert len(censuses) == 2 * len(FLEET_ACTIONS)
+    for census in censuses:
+        assert C.check_rank_consistency(census) == [], census.name
+        assert len(census.sequences) == 4
+
+
+def test_fleet_plan_census_catches_rank_keyed_directive():
+    """Seeded POSITIVE fixture (ISSUE 16): a fleet directive keyed on
+    rank-LOCAL fence state — one zombie rank skipping the adopt-replay
+    broadcast its pool-mates enter — is the `_gather_chunked` hang class
+    wearing a fleet hat, and the detector must pin it CRITICAL."""
+    from implicitglobalgrid_tpu.analysis import collectives as C
+    from implicitglobalgrid_tpu.analysis.ir import RankCensus
+    from implicitglobalgrid_tpu.fleet.policy import fleet_plan
+
+    census = RankCensus(
+        name="host/fleet_plan[broken-rank-keyed-fence]",
+        sequences={
+            rank: fleet_plan(rank == 0, "respawn", stale=(rank == 2))
+            for rank in range(4)
+        },
+    )
+    findings = C.check_rank_consistency(census)
+    assert findings and findings[0].severity == "CRITICAL"
+    assert findings[0].code == "rank-divergent-sequence"
+
+
 def test_gather_collective_plan_ignores_is_root_and_covers_ragged_tail():
     import numpy as np
 

@@ -69,6 +69,24 @@ def test_generation_publish_read_roundtrip(clean_env, tmp_path):
     assert gen_mod.authoritative_generation(d) == 3
 
 
+def test_corrupt_fence_file_reads_absent_but_is_evented(clean_env, tmp_path):
+    """A torn/corrupt generation.json must not wedge the run (it reads as
+    "no fence"), but because that state disarms zombie refusal it has to
+    land on the timeline — unlike a genuinely absent file, which is the
+    normal unsupervised case and stays silent."""
+    telem = tmp_path / "telemetry"
+    clean_env.setenv("IGG_TELEMETRY", "1")
+    clean_env.setenv("IGG_TELEMETRY_DIR", str(telem))
+    assert gen_mod.authoritative_generation(str(tmp_path)) is None
+    assert "fence.corrupt_total" not in tele.snapshot()["counters"]
+    (tmp_path / gen_mod.GENERATION_FILE).write_text("{torn mid-write")
+    assert gen_mod.authoritative_generation(str(tmp_path)) is None
+    assert tele.snapshot()["counters"]["fence.corrupt_total"] == 1
+    events = _events(telem / "events.jsonl")
+    corrupt = [x for x in events if x["type"] == "fence.corrupt"]
+    assert corrupt and corrupt[0]["path"].endswith(gen_mod.GENERATION_FILE)
+
+
 def test_unfenced_process_never_refused(clean_env, tmp_path):
     # no IGG_GENERATION: every check passes whatever the fence file says
     gen_mod.publish_generation(9, str(tmp_path))
