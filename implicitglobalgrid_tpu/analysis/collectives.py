@@ -511,6 +511,44 @@ def fleet_plan_censuses(ctx: Context):
 register_census_provider(fleet_plan_censuses)
 
 
+def integrity_plan_censuses(ctx: Context):
+    """The integrity plane's per-step schedule per simulated rank.
+
+    `integrity.plan.integrity_plan` is the single source of what one
+    guarded step's integrity observation implies on the wire: the
+    transport checksum must add NO collective (the checksum word rides
+    the existing ``ppermute`` payload; verification is a local recompute
+    and a mismatch raises LOCALLY, escalated out-of-band through the
+    ``sdc`` flight bundle), and the shadow audit's one replicated
+    bit-compare ``psum`` must key ONLY on the rank-uniform cadence
+    (``IGG_INTEGRITY_EVERY`` via the env tier), never on a rank-local
+    verdict — a rank-local integrity verdict driving a collective is the
+    `_gather_chunked` hang class wearing an integrity hat.  ``is_root``
+    exists precisely so this census can prove rank identity does not
+    shape the schedule.
+    """
+    from ..integrity.plan import integrity_plan
+
+    for checksums in (False, True):
+        for audit_every, step in ((0, 5), (4, 4), (4, 5)):
+            for dims in (1, 3):
+                yield RankCensus(
+                    name=f"host/integrity_plan[checksums={checksums},"
+                    f"every={audit_every},step={step},dims={dims}]",
+                    sequences={
+                        rank: integrity_plan(
+                            is_root=(rank == 0), checksums=checksums,
+                            audit_every=audit_every, step=step,
+                            exchange_dims=dims,
+                        )
+                        for rank in range(4)
+                    },
+                )
+
+
+register_census_provider(integrity_plan_censuses)
+
+
 def host_plan_findings(ctx: Context) -> list[Finding]:
     out = []
     for provider in list(CENSUS_PROVIDERS):

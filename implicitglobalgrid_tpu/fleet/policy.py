@@ -14,8 +14,11 @@ Actions (`FLEET_ACTIONS`):
                      generation, relaunch it on the same device subset,
                      and replay its unfinished request specs (requests
                      carry parameters, never arrays — replay is safe).
-``quarantine``       respawn strikes exhausted: pin the pool's device
-                     subset out of the fleet and stop routing to it.
+``quarantine``       respawn strikes exhausted — or an ``sdc`` incident
+                     (silent data corruption proven by the `integrity`
+                     plane), which skips the strikes entirely: pin the
+                     pool's device subset out of the fleet and stop
+                     routing to it.
 ``spill``            a pool is hot (sustained queue depth at/above
                      ``IGG_FLEET_SPILL_QUEUE``): spawn a FRESH pool and
                      route overflow there instead of resizing a live one.
@@ -172,7 +175,9 @@ def decide_pool(incident, state: FleetState, policy: FleetPolicy,
 
     ``incident`` is a `supervisor.classify.Incident`-shaped object whose
     ``kind`` is a pool liveness verdict: ``died`` (process gone),
-    ``wedged`` (alive but unreachable/stalled), ``hot`` (sustained queue
+    ``wedged`` (alive but unreachable/stalled), ``sdc`` (an integrity-
+    plane detector convicted a member of silent data corruption — device-
+    subset quarantine, never a respawn strike), ``hot`` (sustained queue
     pressure), ``idle`` or ``healthy``.  ``spilled`` marks pools the
     fleet itself spawned (only those ever retire — the seed pools are the
     capacity floor).  Same inputs, same decision — no clocks, no globals.
@@ -180,6 +185,27 @@ def decide_pool(incident, state: FleetState, policy: FleetPolicy,
     pool = incident.detail.get("pool") if incident.detail else None
     if pool is None:
         raise ValueError("incident.detail must carry the pool name")
+    if incident.kind == "sdc":
+        # An integrity-plane detector (``reason=sdc`` bundle, the
+        # `integrity` package) convicted a member of this pool of FINITE
+        # wrong values.  No respawn strikes: a crashed pool gets its
+        # devices back because crashes are usually software, but silent
+        # corruption is the silicon itself lying — respawning on the same
+        # device subset re-seats the liar under fresh state.  The subset
+        # is pinned out immediately; capacity recovers through the normal
+        # spill path on healthy devices.
+        devices = incident.detail.get("devices")
+        detector = incident.detail.get("detector", "integrity")
+        return FleetDecision(
+            action="quarantine", pool=pool,
+            reason=(
+                f"pool {pool} caught corrupting data in flight "
+                f"({detector}, rank(s) {tuple(incident.ranks)}): "
+                f"quarantining its device subset immediately — respawn "
+                f"would re-seat the lying core"
+            ),
+            quarantined=(devices,) if devices else (),
+        )
     if incident.kind in _POOL_FAILED:
         used = state.respawns.get(pool, 0)
         if used >= policy.respawn_limit:

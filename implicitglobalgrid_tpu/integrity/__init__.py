@@ -1,0 +1,76 @@
+"""Silent-data-corruption integrity plane (docs/robustness.md).
+
+Every pre-existing integrity mechanism in this stack is at-rest or
+non-finite-only: checkpoint CRC manifests vouch for bytes as written,
+result digests fire at retirement, `check_fields` sees NaN/Inf.  A
+*finite* bit flip in a send slab or one wrong FMA from a mercurial core
+passes all of them and propagates through every subsequent halo exchange —
+at fleet scale (ROADMAP north star) that failure mode is a statistical
+certainty, and the reference's whole contract (PAPER.md: every overlap
+copy faithful) is void once it happens.  This package is the in-flight
+plane that produces the evidence the existing escalation machinery
+(supervisor PR 13, fleet controller PR 15) needs to quarantine the liar:
+
+* `transport` — per-hop XOR-fold checksum words riding the coalesced
+  packed `ppermute` payload (`ops.halo._packed_transport`); the receiver
+  recomputes over the landed slab, a mismatch raises `IntegrityError`
+  implicating the SENDER.  Armed by ``IGG_INTEGRITY=1``; no extra
+  collective, hop count unchanged.
+* `audit` — the shadow-step audit: at ``IGG_INTEGRITY_EVERY`` cadence the
+  guarded time loop re-executes the just-committed step from retained
+  pre-step state and bit-compares (replicated psum verdict) — catches
+  wrong COMPUTE, which no transport checksum can.
+* `lineage` — rolling per-field digest chains in the checkpoint manifest:
+  `verify_checkpoint` can now tell "shard damaged on disk" (CRC) from
+  "state was already corrupt when saved" (CRC clean, lineage mismatch),
+  and `latest_checkpoint` walks past poisoned generations.
+* `plan` — the rank-uniformity contract the ``collective-consistency``
+  analyzer censuses (`analysis.collectives.integrity_plan_censuses`).
+
+Escalation: every detector trip dumps a ``reason=sdc`` flight bundle
+naming the implicated rank; `supervisor.classify` maps it to the
+``silent_corruption`` class whose policy verdict is QUARANTINE — a lying
+core re-lies, so restart-in-place is exactly wrong; `fleet.policy` treats
+an SDC-struck pool as a device-subset quarantine candidate.  The
+``bit_flip`` fault kind (`utils.resilience`) proves every detector live
+by injection.
+"""
+
+from .audit import AuditReport, audit_fields
+from .errors import IntegrityError
+from .lineage import (
+    block_digest,
+    chain_field_digests,
+    field_digests_from_blocks,
+    lineage_problem,
+    read_prev_chain,
+    stream_npz_block_digests,
+)
+from .plan import integrity_plan
+from .transport import (
+    TransportCollector,
+    active_collector,
+    append_checksum,
+    fold_words,
+    split_and_verify,
+    use_collector,
+)
+
+__all__ = [
+    "AuditReport",
+    "IntegrityError",
+    "TransportCollector",
+    "active_collector",
+    "append_checksum",
+    "audit_fields",
+    "block_digest",
+    "chain_field_digests",
+    "field_digests_from_blocks",
+    "fold_words",
+    "integrity_plan",
+    "lineage_problem",
+    "read_prev_chain",
+    "split_and_verify",
+    "stream_npz_block_digests",
+    "use_collector",
+]

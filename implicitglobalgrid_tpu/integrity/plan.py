@@ -1,0 +1,49 @@
+"""The integrity plane's rank-uniformity contract (analyzer census).
+
+`integrity_plan` states, per simulated RANK, the ordered host-transport
+collective schedule one integrity-plane observation implies — the input
+of `analysis.collectives.integrity_plan_censuses`.  The plane's SPMD
+discipline has two halves, and the census pins both:
+
+* the transport checksum adds NO collective: the checksum word rides the
+  existing `ppermute` payload, verification is a pure local recompute,
+  and a mismatch raises LOCALLY (escalation is the out-of-band ``sdc``
+  flight bundle) — so the plan for an exchange is one entry per hop
+  whether or not checksums are armed, identical on every rank;
+* the shadow audit's one extra collective (the replicated bit-compare
+  `psum`) is keyed ONLY on the rank-uniform cadence (`IGG_INTEGRITY_EVERY`
+  arrives identically via the environment tier), never on a rank-local
+  verdict — a rank-local integrity verdict driving a collective is the
+  `_gather_chunked` deadlock class wearing an integrity hat.
+"""
+
+from __future__ import annotations
+
+__all__ = ["integrity_plan"]
+
+
+def integrity_plan(is_root: bool, *, checksums: bool, audit_every: int,
+                   step: int, exchange_dims: int = 1) -> tuple:
+    """The ordered host-transport schedule of ONE guarded step on one rank.
+
+    ``is_root`` exists precisely so the census can prove the schedule
+    ignores rank identity (the `ops.gather.collective_plan` contract).
+    ``checksums`` — transport checksums armed (``IGG_INTEGRITY=1``);
+    ``audit_every`` — shadow-audit cadence (0 = off); ``step`` — 1-based
+    committed step; ``exchange_dims`` — dimensions the step's halo
+    exchange permutes.  All four are rank-uniform inputs: the env tier
+    delivers the knobs identically, the step counter advances in lockstep.
+    """
+    del is_root  # rank identity must not shape the schedule
+    plan = []
+    for d in range(exchange_dims):
+        # one ppermute pair per exchanged dimension, checksums or not —
+        # the checksum word rides the same hop (payload-only delta)
+        plan.append(
+            ("ppermute_pair", d, "checksummed" if checksums else "plain")
+        )
+    if audit_every and step % audit_every == 0:
+        # the replicated bit-compare reduction: cadence-keyed, never
+        # verdict-keyed
+        plan.append(("psum", "audit-compare"))
+    return tuple(plan)

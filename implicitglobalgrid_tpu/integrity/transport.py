@@ -1,0 +1,137 @@
+"""Transport checksums for the coalesced packed halo transport.
+
+`ops.halo._packed_transport` concatenates every same-width field slab of
+one (dimension, direction) hop into a single unsigned-word buffer and
+moves it with ONE `lax.ppermute` pair.  When a `TransportCollector` is
+active (installed by `ops.halo.update_halo`'s host entry while
+``IGG_INTEGRITY=1``), the sender appends one extra word — an XOR fold of
+the payload words — to that same buffer, and the receiver recomputes the
+fold over the landed payload and compares it against the landed checksum
+word.  SPMD-safe by construction: both sides evaluate the same pure
+function of data they already hold, so the hop count is unchanged and the
+payload grows by exactly one word per (dimension, width-group, direction).
+
+The fold operates on the *word view* (`ops.halo._flat_words` bitcast), so
+``-0.0`` and NaN payload bytes are bitwise-covered — the whole point of an
+SDC detector is that a flipped mantissa bit is still a perfectly finite
+float.  An XOR fold misses only even-multiplicity identical-position
+flips, far below the single-bit-upset model this plane targets.
+
+The collector is trace-time state: `ops.halo._global_update_fn` builds the
+integrity-enabled exchange program under `use_collector`, the traced
+mismatch flags escape as one extra tiny program output, and the host entry
+reads its OWN addressable flag blocks — a rank-local verdict that raises
+`IntegrityError` locally (escalation via the ``sdc`` flight bundle) and
+never drives a collective.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .errors import IntegrityError
+
+__all__ = [
+    "IntegrityError",
+    "TransportCollector",
+    "active_collector",
+    "use_collector",
+    "fold_words",
+    "append_checksum",
+    "split_and_verify",
+]
+
+
+class TransportCollector:
+    """Trace-time registry of one integrity-enabled exchange build.
+
+    ``records`` — host metadata per checksummed hop, in trace order:
+    ``{"dim", "width", "fields"}`` (``fields`` = positional indices of the
+    fields packed into that width group).  ``flags`` — the matching traced
+    ``(bad_lo, bad_hi)`` mismatch booleans.  The collector lives in the jit
+    cache next to its compiled program: the records fill during the first
+    (tracing) call and label the flag outputs of every later cached call.
+
+    ``flip_proc`` — an armed ``bit_flip:…:transport`` injection target:
+    the first checksummed hop traced after arming XORs one payload word
+    bit on that rank's send buffers (AFTER the checksum fold — in-flight
+    corruption, exactly what the receiver's recompute must catch).
+    """
+
+    def __init__(self, flip_proc: int | None = None):
+        self.records: list[dict] = []
+        self.flags: list[tuple] = []
+        self.flip_proc = flip_proc
+
+    def record(self, *, dim, width, fields, bad_lo, bad_hi) -> None:
+        self.records.append(
+            {"dim": int(dim), "width": int(width), "fields": tuple(fields)}
+        )
+        self.flags.append((bad_lo, bad_hi))
+
+    def take_flip(self) -> int | None:
+        """Consume the armed in-flight flip target (first hop only)."""
+        proc, self.flip_proc = self.flip_proc, None
+        return proc
+
+    def stacked_flags(self):
+        """The traced flags as one ``(nrecords, 2)`` int32 array."""
+        import jax.numpy as jnp
+
+        if not self.flags:
+            return jnp.zeros((0, 2), dtype=jnp.int32)
+        return jnp.stack(
+            [jnp.stack([lo.astype(jnp.int32), hi.astype(jnp.int32)])
+             for lo, hi in self.flags]
+        )
+
+
+_active: TransportCollector | None = None
+
+
+def active_collector() -> TransportCollector | None:
+    """The collector of the integrity-enabled exchange being traced, or
+    None — the signal `_packed_transport` keys checksum emission on."""
+    return _active
+
+
+@contextlib.contextmanager
+def use_collector(col: TransportCollector):
+    global _active
+    prev = _active
+    _active = col
+    try:
+        yield col
+    finally:
+        _active = prev
+
+
+def fold_words(buf):
+    """XOR fold of a 1-D unsigned-word buffer to one scalar word."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if buf.size == 0:
+        return jnp.zeros((), dtype=buf.dtype)
+    return lax.reduce(
+        buf, jnp.zeros((), dtype=buf.dtype), lax.bitwise_xor, (0,)
+    )
+
+
+def append_checksum(buf):
+    """``payload ++ [fold(payload)]`` — the wire form of one hop buffer."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate([buf, fold_words(buf)[None]])
+
+
+def split_and_verify(recv):
+    """Landed hop buffer -> ``(payload, mismatch)``.
+
+    ``mismatch`` is a traced boolean: recomputed fold over the landed
+    payload words != the landed checksum word.  PROC_NULL edges are safe
+    by construction — `ops.halo._permute_slabs` already substituted the
+    keep buffer, whose checksum was computed from the same words.
+    """
+    payload, chk = recv[:-1], recv[-1]
+    return payload, fold_words(payload) != chk

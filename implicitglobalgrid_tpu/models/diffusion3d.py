@@ -730,6 +730,7 @@ def run(
     checkpoint_every: int | None = None,
     checkpoint_dir: str | None = None,
     checkpoint_keep: int | None = None,
+    integrity_every: int | None = None,
     **setup_kwargs,
 ):
     """End-to-end run (the reference's ``diffusion3D()`` without visualization).
@@ -745,7 +746,11 @@ def run(
     (elastic restart: re-init with any ``dims``/local sizes implying the
     same global grid).  ``checkpoint_keep=N`` (``IGG_CHECKPOINT_KEEP``)
     prunes to the newest N generations after each save, never deleting the
-    only integrity-verified one.
+    only integrity-verified one.  ``integrity_every=N``
+    (``IGG_INTEGRITY_EVERY``) arms the shadow-step audit: every Nth step
+    is re-executed from retained pre-step state and bit-compared — a
+    finite silent corruption the NaN/Inf guard can never see raises
+    `integrity.IntegrityError` naming the corrupting rank.
     """
     import jax
 
@@ -773,6 +778,7 @@ def run(
             checkpoint_every=checkpoint_every,
             checkpoint_dir=checkpoint_dir,
             checkpoint_keep=checkpoint_keep,
+            integrity_every=integrity_every,
             names=("T", "Cp"),
         )
         # On the virtual CPU mesh, XLA's in-process collectives deadlock if

@@ -932,13 +932,15 @@ def run(
     checkpoint_every: int | None = None,
     checkpoint_dir: str | None = None,
     checkpoint_keep: int | None = None,
+    integrity_every: int | None = None,
     **kw,
 ):
     """End-to-end run; returns the final global-block temperature field.
 
     Resilience hooks as in `models.diffusion3d.run` (``guard_every`` /
     ``guard_policy`` / ``checkpoint_every`` / ``checkpoint_dir`` /
-    ``checkpoint_keep``; resume is topology-elastic)."""
+    ``checkpoint_keep`` / ``integrity_every``; resume is
+    topology-elastic)."""
     import jax
 
     from ..parallel.grid import global_grid, grid_is_initialized
@@ -961,6 +963,7 @@ def run(
             checkpoint_every=checkpoint_every,
             checkpoint_dir=checkpoint_dir,
             checkpoint_keep=checkpoint_keep,
+            integrity_every=integrity_every,
             names=("T", "Pf", "qDx", "qDy", "qDz"),
         )
         sync_every_step = global_grid().mesh.devices.flat[0].platform == "cpu"

@@ -74,6 +74,32 @@ from ..parallel.topology import AXIS_NAMES, NDIMS
 
 _jit_cache: dict = {}
 
+#: Integrity-enabled exchange programs — (fn, TransportCollector) per key.
+#: Separate from `_jit_cache` on purpose: the plain path's cache keys (and
+#: the ``IGG_INTEGRITY=0`` zero-overhead pin) stay byte-for-byte unchanged.
+_integrity_jit_cache: dict = {}
+
+#: Armed ``bit_flip:…:transport`` target rank, consumed by the next
+#: integrity-enabled global exchange (`utils.resilience` arms it with the
+#: same arm-on-step / fire-on-next-collective idiom as ``net_delay``).
+_transport_flip: int | None = None
+
+
+def arm_transport_flip(proc: int) -> None:
+    """Arm a one-shot in-flight payload-word flip on rank ``proc``'s next
+    checksummed transport (the ``bit_flip`` chaos kind's transport
+    placement).  No-op unless ``IGG_INTEGRITY=1`` routes the next global
+    exchange through the checksummed build — the flip is baked into that
+    program's wire buffers, after the checksum fold."""
+    global _transport_flip
+    _transport_flip = int(proc)
+
+
+def _take_transport_flip() -> int | None:
+    global _transport_flip
+    proc, _transport_flip = _transport_flip, None
+    return proc
+
 # Guard/fault hook point: called on the OUTPUT tuple of every global-array
 # `update_halo` (the host-side boundary where concrete fields exist — traced
 # contexts inline into the caller's program and cannot run host hooks).  Two
@@ -94,6 +120,7 @@ def set_post_exchange_hook(fn):
 
 def _clear_caches() -> None:
     _jit_cache.clear()
+    _integrity_jit_cache.clear()
 
 
 def _is_tracer(x) -> bool:
@@ -558,16 +585,48 @@ def _keep_thunks(keeps_lo, keeps_hi, j: int):
     return dict(keep_lo=lambda: keeps_lo[j], keep_hi=lambda: keeps_hi[j])
 
 
+def _flip_wire_word(buf, proc: int, gg):
+    """XOR bit 0 of payload word 0 of rank ``proc``'s wire buffer — the
+    armed ``bit_flip:…:transport`` injection.  Applied AFTER the checksum
+    fold (in-flight corruption: the sender's fold covered the clean words,
+    so the receiver's recompute over the landed payload must disagree)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    # row-major linear rank from the mesh coords (topology.rank_of_coords)
+    rank = lax.axis_index(AXIS_NAMES[0])
+    for dd in range(1, NDIMS):
+        rank = rank * gg.dims[dd] + lax.axis_index(AXIS_NAMES[dd])
+    flipped = buf.at[0].set(buf[0] ^ jnp.array(1, buf.dtype))
+    return jnp.where(rank == proc, flipped, buf)
+
+
 def _packed_transport(gg, d: int):
     """The width-group packed transport as a differentiable function of the
     per-field send/keep slabs.  Primal: bitcast-pack per byte width, one
     `_permute_slabs` pair per group.  VJP: `jax.vjp` of the per-field
     transport over the same operands (value-identical by the coalescing
-    contract, and built from primitives with exact transpose rules)."""
+    contract, and built from primitives with exact transpose rules).
+
+    With a `integrity.transport.TransportCollector` active (the
+    integrity-enabled global exchange, ``IGG_INTEGRITY=1``), every group —
+    singletons included — packs to the word view and the wire buffer grows
+    ONE checksum word (XOR fold of the payload words, `append_checksum`);
+    the receive side recomputes the fold over the landed payload
+    (`split_and_verify`) and the traced mismatch flags register on the
+    collector.  Same hops, payload +1 word per (group, direction); PROC_NULL
+    keep buffers carry their own self-consistent fold, so masked edges can
+    never false-trip.  The checksummed build returns the RAW function (no
+    `custom_vjp` envelope): the host integrity path never differentiates,
+    and flags escaping through a custom-VJP primal would leak tracers.
+    """
     import jax
     import jax.numpy as jnp
 
+    from ..integrity import transport as _itransport
     from ..utils import telemetry as _telemetry
+
+    col = _itransport.active_collector()
 
     def packed(sends_lo, sends_hi, keeps_lo, keeps_hi):
         groups: dict[int, list[int]] = {}
@@ -576,7 +635,7 @@ def _packed_transport(gg, d: int):
         los: list = [None] * len(sends_lo)
         his: list = [None] * len(sends_lo)
         for wbytes, idxs in sorted(groups.items()):
-            if len(idxs) == 1:
+            if len(idxs) == 1 and col is None:
                 (j,) = idxs
                 los[j], his[j] = _permute_slabs(
                     gg, d, send_lo=sends_lo[j], send_hi=sends_hi[j],
@@ -588,25 +647,51 @@ def _packed_transport(gg, d: int):
             sizes = [int(f.shape[0]) for f in flats_lo]
             buf_lo = jnp.concatenate(flats_lo)
             buf_hi = jnp.concatenate(flats_hi)
-            # Trace-time counters (like `halo.begin_slab_traces`): coalesced
-            # exchanges are built into compiled programs, so these count
-            # traced collectives and their per-hop payload bytes
-            # (docs/observability.md).
-            _telemetry.counter("halo.coalesced_collectives").inc(2)
-            _telemetry.counter("halo.coalesced_bytes").inc(
-                2 * int(buf_lo.shape[0]) * wbytes
-            )
-            recv_lo, recv_hi = _permute_slabs(
-                gg, d,
-                send_lo=buf_lo,
-                send_hi=buf_hi,
-                keep_lo=lambda: jnp.concatenate(
-                    [_flat_words(keeps_lo[j]) for j in idxs]
-                ),
-                keep_hi=lambda: jnp.concatenate(
-                    [_flat_words(keeps_hi[j]) for j in idxs]
-                ),
-            )
+            if len(idxs) > 1:
+                # Trace-time counters (like `halo.begin_slab_traces`):
+                # coalesced exchanges are built into compiled programs, so
+                # these count traced collectives and their per-hop payload
+                # bytes (docs/observability.md).
+                _telemetry.counter("halo.coalesced_collectives").inc(2)
+                _telemetry.counter("halo.coalesced_bytes").inc(
+                    2 * int(buf_lo.shape[0]) * wbytes
+                )
+            if col is not None:
+                wire_lo = _itransport.append_checksum(buf_lo)
+                wire_hi = _itransport.append_checksum(buf_hi)
+                flip = col.take_flip()
+                if flip is not None:
+                    wire_lo = _flip_wire_word(wire_lo, flip, gg)
+                    wire_hi = _flip_wire_word(wire_hi, flip, gg)
+                recv_lo, recv_hi = _permute_slabs(
+                    gg, d,
+                    send_lo=wire_lo,
+                    send_hi=wire_hi,
+                    keep_lo=lambda: _itransport.append_checksum(
+                        jnp.concatenate([_flat_words(keeps_lo[j]) for j in idxs])
+                    ),
+                    keep_hi=lambda: _itransport.append_checksum(
+                        jnp.concatenate([_flat_words(keeps_hi[j]) for j in idxs])
+                    ),
+                )
+                recv_lo, bad_lo = _itransport.split_and_verify(recv_lo)
+                recv_hi, bad_hi = _itransport.split_and_verify(recv_hi)
+                col.record(
+                    dim=d, width=wbytes, fields=idxs, bad_lo=bad_lo,
+                    bad_hi=bad_hi,
+                )
+            else:
+                recv_lo, recv_hi = _permute_slabs(
+                    gg, d,
+                    send_lo=buf_lo,
+                    send_hi=buf_hi,
+                    keep_lo=lambda: jnp.concatenate(
+                        [_flat_words(keeps_lo[j]) for j in idxs]
+                    ),
+                    keep_hi=lambda: jnp.concatenate(
+                        [_flat_words(keeps_hi[j]) for j in idxs]
+                    ),
+                )
             off = 0
             for j, size in zip(idxs, sizes):
                 shape, dtype = sends_lo[j].shape, sends_lo[j].dtype
@@ -624,6 +709,10 @@ def _packed_transport(gg, d: int):
             for j in range(len(sends_lo))
         ]
         return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+    if col is not None:
+        # Checksummed build: raw function, no custom_vjp (docstring).
+        return packed
 
     f = jax.custom_vjp(packed)
 
@@ -645,7 +734,15 @@ def _multi_slab_recv_values(fields, d: int, gg, width: int = 1, logicals=None,
     coalesced across fields (`_coalesced_permute`) when ``coalesce`` is on
     and at least two fields actually permute.  Entries are ``None`` where a
     field skips the dimension; ``axes[i]``/``logicals[i]``/``receiveds[i]``
-    as in `_slab_recv_values`."""
+    as in `_slab_recv_values`.
+
+    With a `TransportCollector` active (the integrity-enabled global
+    exchange), EVERY permuting field routes through `_coalesced_permute`
+    regardless of count or the ``coalesce`` flag — the checksum word rides
+    the packed wire form, so per-field hops must pack too (still one
+    ppermute pair per width group; the collective census is unchanged)."""
+    from ..integrity.transport import active_collector
+
     n = len(fields)
     logicals = (None,) * n if logicals is None else tuple(logicals)
     axes = (None,) * n if axes is None else tuple(axes)
@@ -660,7 +757,9 @@ def _multi_slab_recv_values(fields, d: int, gg, width: int = 1, logicals=None,
             out[i] = (p[1], p[2])
         else:
             permuting.append((i, p[1:]))
-    if coalesce and len(permuting) >= 2:
+    if permuting and (
+        active_collector() is not None or (coalesce and len(permuting) >= 2)
+    ):
         vals = _coalesced_permute(gg, d, [p for _, p in permuting])
         for (i, _), v in zip(permuting, vals):
             out[i] = v
@@ -1320,6 +1419,113 @@ def _global_update_fn(gg, shapes_dtypes, width: int = 1, donate: bool = True,
     return fn
 
 
+def _integrity_update_fn(gg, shapes_dtypes, width: int, donate: bool,
+                         coalesce: bool, flip: int | None):
+    """The checksummed twin of `_global_update_fn` (``IGG_INTEGRITY=1``).
+
+    The exchange builds under an active `TransportCollector`, so every hop's
+    wire buffer carries an XOR-fold checksum word (`_packed_transport`) and
+    the per-hop mismatch flags escape as one extra per-block ``(1, 1, 1,
+    nhops, 2)`` int32 output, out-spec sharded over the mesh — the host
+    entry reads its OWN blocks' verdicts from addressable shards, no extra
+    collective.  Cached per (epoch, signature, width, donate, coalesce,
+    flip): an armed transport flip bakes a DIFFERENT program, so a chaos
+    injection never poisons the clean entry.  Returns ``(fn, collector)``;
+    the collector's trace-order records label the flag rows.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..integrity import transport as _itransport
+    from ..utils.compat import shard_map
+
+    key = (gg.epoch, shapes_dtypes, width, donate, coalesce, flip)
+    hit = _integrity_jit_cache.get(key)
+    if hit is not None:
+        return hit
+    ndims_per_field = tuple(len(s) for s, _ in shapes_dtypes)
+    dn = tuple(range(len(ndims_per_field))) if donate else ()
+    col = _itransport.TransportCollector()
+
+    def exchange(*fields):
+        # A retrace rebuilds the records/flags and re-arms the baked flip
+        # (trace-time collector state must match the program every time).
+        col.records.clear()
+        col.flags.clear()
+        col.flip_proc = flip
+        with _itransport.use_collector(col):
+            out = _update_halo_local(fields, gg, width, coalesce)
+        return tuple(out) + (col.stacked_flags()[None, None, None],)
+
+    specs = tuple(P(*AXIS_NAMES[:nd]) for nd in ndims_per_field)
+    mapped = shard_map(
+        exchange, mesh=gg.mesh, in_specs=specs,
+        out_specs=specs + (P(*AXIS_NAMES, None, None),), check_vma=False,
+    )
+    fn = jax.jit(mapped, donate_argnums=dn)
+    _integrity_jit_cache[key] = (fn, col)
+    return fn, col
+
+
+def _check_transport_flags(gg, col, flags) -> None:
+    """Rank-local verdict of one checksummed exchange.
+
+    Scans the flag blocks THIS process hosts; any nonzero entry names a hop
+    whose landed payload contradicts its checksum word.  Escalation is a
+    LOCAL raise plus the out-of-band ``reason=sdc`` flight bundle
+    implicating the SENDER (the wire buffer is the sender's slab until it
+    lands, so a mismatch at the receiver indicts the sending rank/link) —
+    never a collective: a rank-local integrity verdict driving a collective
+    is the SPMD-divergence class `analysis.collectives` exists to catch.
+    """
+    from ..integrity.errors import IntegrityError
+    from ..parallel import topology
+    from ..utils import telemetry as _telemetry
+    from ..utils import tracing as _tracing
+
+    for shard in flags.addressable_shards:
+        arr = np.asarray(shard.data)
+        if not arr.size or not arr.any():
+            continue
+        coords = tuple(
+            int(sl.start or 0) for sl in tuple(shard.index)[:NDIMS]
+        )
+        nbrs = topology.neighbors_table(
+            coords, gg.dims, gg.periods, int(gg.disp)
+        )
+        hop, side = (
+            int(i) for i in np.argwhere(arr.reshape(arr.shape[-2:]))[0]
+        )
+        rec = col.records[hop] if hop < len(col.records) else {}
+        dim = int(rec.get("dim", -1))
+        # flag column 0 = the lo receive (sent by my LOWER partner), column
+        # 1 = the hi receive (sent by my upper partner) — `_permute_slabs`
+        direction = "lo" if side == 0 else "hi"
+        sender = int(nbrs[side, dim]) if dim >= 0 else -1
+        fields = tuple(rec.get("fields", ()))
+        _telemetry.counter("integrity.transport_mismatches").inc()
+        _telemetry.event(
+            "integrity.transport_mismatch", detector="transport_checksum",
+            dim=dim, direction=direction, fields=list(fields),
+            block=list(coords), implicated_rank=sender,
+        )
+        _tracing.dump_flight_recorder(
+            "sdc", detector="transport_checksum", implicated_rank=sender,
+            dim=dim, direction=direction, fields=list(fields),
+            block=list(coords),
+        )
+        raise IntegrityError(
+            f"halo transport checksum mismatch: dim {dim} ({direction} "
+            f"receive) at block {coords} — the landed payload contradicts "
+            f"its checksum word; implicating sender rank {sender}. A finite "
+            f"bit flip in flight passes every NaN guard; quarantine the "
+            f"implicated device (docs/robustness.md), do not restart in "
+            f"place.",
+            detector="transport_checksum", implicated_rank=sender,
+            dim=dim, direction=direction, fields=fields,
+        )
+
+
 def update_halo(*fields, width: int = 1, donate: bool | None = None,
                 coalesce: bool | None = None):
     """Update the halo planes of the given field(s).
@@ -1385,8 +1591,19 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None,
             donate = _default_donate()
         if coalesce is None:
             coalesce = _default_coalesce()
+        from ..utils import config as _config
         from ..utils import telemetry as _telemetry
         from ..utils import tracing as _tracing
+
+        # Transport checksums (docs/robustness.md): host-side resolution,
+        # like IGG_DONATE/IGG_COALESCE — the traced paths never read the
+        # env (knob-binding lint).  Only communicating grids have a wire
+        # to checksum.
+        integrity = (
+            _config.integrity_enabled_env() is True
+            and (gg.nprocs > 1 or gg.force_spmd)
+        )
+        flip = _take_transport_flip() if integrity else None
 
         if _telemetry.enabled():
             # Runtime counters (the global-array entry runs host-side per
@@ -1402,9 +1619,16 @@ def update_halo(*fields, width: int = 1, donate: bool | None = None,
         with _tracing.trace_span(
             "igg_halo_exchange", fields=len(arrs), width=width
         ):
-            out = _global_update_fn(
-                gg, sig, width, bool(donate), bool(coalesce)
-            )(*arrs)
+            if integrity:
+                fn, col = _integrity_update_fn(
+                    gg, sig, width, bool(donate), bool(coalesce), flip
+                )
+                *out, flags = fn(*arrs)
+                _check_transport_flags(gg, col, flags)
+            else:
+                out = _global_update_fn(
+                    gg, sig, width, bool(donate), bool(coalesce)
+                )(*arrs)
         if _post_exchange_hook is not None:
             out = tuple(_post_exchange_hook(tuple(out)))
     return out[0] if len(fields) == 1 else tuple(out)

@@ -207,6 +207,17 @@ class ServingLoop:
         self.max_rollbacks = int(max_rollbacks)
         self.checkpoint_every = int(checkpoint_every)
         self.checkpoint_dir = checkpoint_dir
+        # Shadow-step audit cadence (the integrity plane; docs/
+        # robustness.md).  A batched pool audits ONE sampled member per
+        # audited round: the whole pool's round is re-executed through the
+        # SAME compiled multi-step (no second program) and the sample is
+        # bit-compared — round-robin over active slots, so a lying core
+        # is caught within `capacity` audited rounds.  ``IGG_INTEGRITY=0``
+        # force-disables, same pin as the run guard.
+        every = _config.integrity_every_env() or 0
+        if _config.integrity_enabled_env() is False:
+            every = 0
+        self.integrity_every = int(every)
         # donate=False: the raw step's inputs survive for the post-step
         # mask select (which donates both and recycles the buffers).
         self._step = model.make_multi_step(
@@ -546,6 +557,14 @@ class ServingLoop:
             if self._state is not None and mask.any():
                 t0 = time.perf_counter()
                 new = self._step(*self._state)
+                if (
+                    self.integrity_every
+                    and (self.rounds + 1) % self.integrity_every == 0
+                ):
+                    # Before select_members: the mask select donates both
+                    # the stepped output and the pre-step state, so the
+                    # audit's re-execution must run while both survive.
+                    self._audit_member(new, mask)
                 # Masking AFTER the step bit-freezes non-running members;
                 # the step itself ran every slot (that is what batching
                 # means — the flops of idle slots are the price of the
@@ -622,6 +641,63 @@ class ServingLoop:
                 self._save_checkpoint()
             self._admit_from_queue()
             self._prune_results()
+
+    def _audit_member(self, new, mask: np.ndarray) -> None:
+        """Shadow-step audit of ONE sampled member (integrity plane).
+
+        Re-executes the round's multi-step from the retained pre-step pool
+        state (``donate=False`` keeps it alive) through the same compiled
+        program and bit-compares the sampled member's fields
+        (`integrity.audit_fields`).  The sample is round-robin over the
+        ACTIVE slots keyed on the deterministic round counter — rank-
+        uniform by construction, so the audit's replicated bit-compare
+        collective fires on every rank together.  A mismatch is silent
+        data corruption caught in compute: dump the ``reason=sdc`` flight
+        bundle naming the implicated rank and raise — the pool dies loud,
+        the fleet controller quarantines its device subset
+        (`fleet.policy.decide_pool` kind ``sdc``).
+        """
+        active = [
+            k for k, s in enumerate(self.slots) if s.active and mask[k]
+        ]
+        if not active:
+            return
+        k = active[self.rounds % len(active)]
+        from ..integrity import IntegrityError, audit_fields
+
+        redone = self._step(*self._state)
+        report = audit_fields(
+            _batched.member_state(tuple(new), k),
+            _batched.member_state(tuple(redone), k),
+            names=self.info["names"],
+        )
+        _telemetry.counter("integrity.audits").inc()
+        if report.ok:
+            return
+        slot = self.slots[k]
+        _telemetry.counter("integrity.audit_mismatches").inc()
+        _telemetry.event(
+            "integrity.audit_mismatch", detector="shadow_audit",
+            round=self.rounds, member=slot.member, slot=k,
+            tenant=slot.tenant, fields=list(report.bad_blocks),
+            implicated_ranks=list(report.implicated_ranks),
+        )
+        implicated = (
+            report.implicated_ranks[0] if report.implicated_ranks else None
+        )
+        _tracing.dump_flight_recorder(
+            "sdc", detector="shadow_audit", round=self.rounds,
+            member=slot.member, slot=k, implicated_rank=implicated,
+            implicated_ranks=list(report.implicated_ranks),
+            report=report.summary(),
+        )
+        raise IntegrityError(
+            f"silent data corruption: serving round {self.rounds} member "
+            f"{slot.member} (slot {k}) does not bit-reproduce on "
+            f"re-execution — {report.summary()}",
+            detector="shadow_audit", implicated_rank=implicated,
+            step=self.rounds,
+        )
 
     def _guard(self, mask: np.ndarray) -> None:
         if self.guard_policy == "off":

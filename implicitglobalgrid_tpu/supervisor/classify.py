@@ -26,6 +26,14 @@ Classes (`FAILURE_KINDS`):
 ``corrupt_checkpoint`` integrity machinery engaged: ``checkpoint.fallback``
                        / ``checkpoint.verify_failed`` events next to a
                        failed rank — the newest generation is damaged.
+``silent_corruption``  an integrity-plane detector (transport checksum or
+                       shadow-step audit, the `integrity` package) caught
+                       a FINITE wrong value in flight and dumped a
+                       ``reason=sdc`` bundle.  The incident implicates the
+                       rank the DETECTOR names (``info.implicated_rank`` —
+                       the sender of a bad slab, not the receiver that
+                       noticed), because that is whose silicon is lying;
+                       policy must quarantine it, never restart-in-place.
 ``step_stall``         a latched ``alert.step_stall`` (live-plane rule) or
                        a watchdog flight bundle: the loop wedged.
 ``straggler``          ``skew.straggler`` / ``alert.skew_sustained``
@@ -57,6 +65,7 @@ FAILURE_KINDS = (
     "guard_trip",
     "gather_tripwire",
     "corrupt_checkpoint",
+    "silent_corruption",
     "step_stall",
     "straggler",
     "crash",
@@ -73,8 +82,11 @@ CRASH_STATUS = _FaultInjector.CRASH_STATUS
 #: pinned by `tests/test_supervisor.py::test_exit_status_constants_agree`.
 RESIZE_STATUS = 19
 
-#: flight-bundle reasons mapped straight to a class (most-specific wins)
+#: flight-bundle reasons mapped straight to a class (most-specific wins —
+#: ``sdc`` first: an integrity trip often cascades into guard trips and
+#: crashes on peer ranks, and the root cause must not vanish into those)
 _BUNDLE_KINDS = (
+    ("sdc", "silent_corruption"),
     ("gather_tripwire", "gather_tripwire"),
     ("guard.trip", "guard_trip"),
     ("watchdog.deadline_exceeded", "step_stall"),
@@ -175,13 +187,14 @@ def _shard_ranks(ckpt_events: Sequence[dict]) -> tuple[int, ...]:
     return tuple(sorted(ranks))
 
 
-def _bundle_class(bundles: dict) -> tuple[str, int, str] | None:
-    """Most specific (kind, rank, reason) across every rank's bundles."""
+def _bundle_class(bundles: dict) -> tuple[str, int, str, dict] | None:
+    """Most specific (kind, rank, reason, record) across every rank's
+    bundles."""
     for reason, kind in _BUNDLE_KINDS:
         for rank, recs in sorted(bundles.items()):
             for rec in recs:
                 if rec.get("reason") == reason:
-                    return kind, rank, reason
+                    return kind, rank, reason, rec
     return None
 
 
@@ -248,10 +261,23 @@ def classify(
         # to exit badly — a corrupting rank can take innocent peers down
         # with it.  The exit picture stays visible through ``rcs``.
         if specific is not None:
-            kind, rank, reason = specific
+            kind, rank, reason, rec = specific
             detail["bundle_reason"] = reason
             detail["bundle_rank"] = rank
-            return Incident(kind=kind, ranks=(rank,), rcs=rcs,
+            ranks = (rank,)
+            if kind == "silent_corruption":
+                # The bundle-writing rank is the DETECTING rank (a transport
+                # checksum trips on the receiver); the corruption lives on
+                # the rank the detector names.  Quarantine must target the
+                # liar, not the witness.
+                info = rec.get("info") or {}
+                if info.get("detector"):
+                    detail["detector"] = info["detector"]
+                imp = info.get("implicated_rank")
+                if imp is not None:
+                    ranks = (int(imp),)
+                    detail["implicated_rank"] = int(imp)
+            return Incident(kind=kind, ranks=ranks, rcs=rcs,
                             detail=detail)
         if ckpt_events:
             detail["checkpoint_problems"] = [

@@ -23,7 +23,11 @@ Actions (`ACTIONS`):
 ``resize``     the workload itself asked (`serving.RESIZE_STATUS` + plan).
 ``quarantine`` the implicated rank keeps producing integrity failures
                (corrupt checkpoints) or tripwire faults: pin it out of
-               every future incarnation and shrink around it.
+               every future incarnation and shrink around it.  A
+               ``silent_corruption`` incident skips the strike bar and
+               quarantines on the FIRST offense — restarting a rank whose
+               silicon produced a finite wrong value hands it fresh state
+               to corrupt.
 ``none``       healthy — nothing to do.
 ``give_up``    no rung fits (everything quarantined / ladder exhausted).
 
@@ -63,8 +67,14 @@ ACTIONS = (
 
 #: incident kinds that consume a restart strike (transient-looking faults)
 _TRANSIENT = ("crash", "step_stall", "guard_trip", "straggler")
-#: incident kinds that mark the implicated rank suspect (integrity class)
-_SUSPECT = ("corrupt_checkpoint", "gather_tripwire")
+#: incident kinds that mark the implicated rank suspect (integrity class).
+#: ``silent_corruption`` is in the suspect family for strike bookkeeping,
+#: but `decide` short-circuits it to IMMEDIATE quarantine: the other
+#: suspect kinds tolerate strikes because their damage is at rest and the
+#: checkpoint fallback routes around it, while a rank whose silicon
+#: produced a finite wrong value re-lies on restart — restart-in-place is
+#: exactly the wrong verdict for a liar.
+_SUSPECT = ("corrupt_checkpoint", "gather_tripwire", "silent_corruption")
 
 DEFAULT_MAX_RESTARTS = 2
 
@@ -199,6 +209,33 @@ def decide(incident, state: SupervisorState, policy: RecoveryPolicy,
     if incident.kind == "resize":
         return Decision(action="resize", rung=state.rung, delay_s=0.0,
                         reason="workload-requested resize")
+
+    if incident.kind == "silent_corruption" and incident.ranks:
+        # No strike bar: one proven finite wrong value is enough.  The
+        # detector (transport checksum / shadow audit / lineage chain)
+        # already localized the liar; giving it `quarantine_after` more
+        # incarnations just feeds it more state to corrupt.
+        doomed = tuple(incident.ranks)
+        rung = state.rung + 1
+        detector = (incident.detail or {}).get("detector", "integrity")
+        if rung >= ladder_len:
+            return Decision(
+                action="give_up", rung=state.rung, delay_s=0.0,
+                reason=(
+                    f"rank(s) {doomed} caught corrupting data in flight "
+                    f"({detector}) but no smaller rung exists"
+                ),
+                quarantined=doomed,
+            )
+        return Decision(
+            action="quarantine", rung=rung, delay_s=_backoff(policy, 0),
+            reason=(
+                f"rank(s) {doomed} caught corrupting data in flight "
+                f"({detector}): quarantined immediately, shrinking to "
+                f"rung {rung}"
+            ),
+            quarantined=doomed,
+        )
 
     if incident.kind in _SUSPECT:
         # strike counts maintained by `SupervisorState.record_incident`

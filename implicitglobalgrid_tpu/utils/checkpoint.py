@@ -28,8 +28,13 @@ the manifest are on disk — a crash mid-save never leaves a visible
 byte counts; `verify_checkpoint` replays them, and `latest_checkpoint`
 falls back generation by generation to the newest checkpoint that passes —
 a torn or bit-flipped shard is detected and *skipped*, never restored into
-a silently wrong run.  Format-1 directories (pre-manifest) stay readable:
-their completion marker is the presence of ``meta.json``.
+a silently wrong run.  The manifest additionally carries rolling per-field
+lineage digests (`integrity.lineage`), hashed from the live arrays before
+any byte hits disk: a CRC-clean generation whose stored bytes contradict
+its lineage was already corrupt when saved (silent data corruption in the
+writer path), and the same fallback walks past it.  Format-1 directories
+(pre-manifest) stay readable: their completion marker is the presence of
+``meta.json``.
 
 Elastic restore: the global grid is *implicit* — any ``(nxyz, dims,
 overlaps, periods)`` implying the same ``nxyz_g`` describes the same
@@ -58,6 +63,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from ..integrity import lineage as _lineage
 from ..parallel import grid as _grid
 from ..parallel.topology import AXIS_NAMES
 from . import config as _config
@@ -209,6 +215,20 @@ def _save_checkpoint(
             payload[key] = data.view(np.uint8).reshape(-1)
             payload[key + "_shape"] = np.asarray(data.shape, dtype=np.int64)
 
+    # Lineage digests (integrity.lineage): hash every block's payload bytes
+    # from the LIVE arrays, BEFORE the npz writer (or the in-tree
+    # ``bit_flip:…:ckpt`` injection below) touches them — the digest vouches
+    # for the state being saved, the CRC for the bytes as written.  A
+    # divergence between the two is the poisoned-at-save class.
+    block_digests = {
+        key: _lineage.block_digest(buf)
+        for key, buf in payload.items()
+        if not key.endswith("_shape")
+    }
+    from . import resilience as _res
+
+    _res.get_fault_injector().maybe_bit_flip_ckpt(payload, step)
+
     shard_path = os.path.join(tmp_dir, _shard_name(pid))
     tmp = shard_path + ".tmp"
     with open(tmp, "wb") as f:
@@ -220,6 +240,7 @@ def _save_checkpoint(
         "file": _shard_name(pid),
         "bytes": os.path.getsize(shard_path),
         "crc32": _crc32_file(shard_path),
+        "blocks": block_digests,
     }
     tmp = shard_path + ".crc.json.tmp"
     with open(tmp, "w") as f:
@@ -232,6 +253,7 @@ def _save_checkpoint(
     _dist.sync_all_processes()
     if pid == 0:
         shards: dict[str, dict] = {}
+        all_blocks: dict[str, str] = {}
         for p in range(jax.process_count()):
             sc_path = os.path.join(tmp_dir, _shard_name(p) + ".crc.json")
             try:
@@ -244,6 +266,24 @@ def _save_checkpoint(
                     f"the checkpoint directory shared by all processes?"
                 )
             shards[rec["file"]] = {"bytes": rec["bytes"], "crc32": rec["crc32"]}
+            all_blocks.update(rec.get("blocks") or {})
+        # Roll the lineage chain forward from the newest OLDER published
+        # generation (a same-step rerun replaces its generation, so it must
+        # not chain against itself); absent/foreign predecessors reset to
+        # genesis inside `chain_field_digests`.
+        prev_meta_path = None
+        prev_step = None
+        for s, p in reversed(checkpoint_steps(directory)):
+            if s < step:
+                prev_meta_path, prev_step = os.path.join(p, _META), s
+                break
+        field_digests = _lineage.field_digests_from_blocks(
+            all_blocks, len(state)
+        )
+        chain = _lineage.chain_field_digests(
+            field_digests,
+            _lineage.read_prev_chain(prev_meta_path, len(state)),
+        )
         meta = {
             "format": FORMAT_VERSION,
             "step": step,
@@ -252,6 +292,14 @@ def _save_checkpoint(
             "grid": gg.checkpoint_meta(),
             "process_count": int(jax.process_count()),
             "shards": shards,
+            "lineage": {
+                "fields": [
+                    {"digest": d, "chain": c}
+                    for d, c in zip(field_digests, chain)
+                ],
+                "blocks": all_blocks,
+                "prev_step": prev_step,
+            },
             "extra": extra or {},
         }
         # The writing incarnation's generation token (docs/robustness.md):
@@ -330,8 +378,14 @@ def verify_checkpoint(path: str | os.PathLike) -> str | None:
 
     Format 2: every manifest-listed shard file must exist with the recorded
     byte count and CRC32 — detects truncation (torn write) and corruption
-    (bit flips) before a restore can propagate them.  Format 1 predates the
-    manifest: the completion marker is the only check (legacy semantics).
+    (bit flips) before a restore can propagate them.  After the CRC pass,
+    the manifest's lineage digests are replayed (`integrity.lineage`,
+    streamed in bounded chunks so a sweep over pod-scale shards never
+    spikes RSS): a CRC-clean generation whose bytes do not reproduce the
+    per-field digest chain was already corrupt WHEN SAVED — a poisoned
+    generation `latest_checkpoint` walks past like any other invalid one.
+    Format 1 predates the manifest: the completion marker is the only
+    check (legacy semantics).
     """
     path = os.fspath(path)
     meta_path = os.path.join(path, _META)
@@ -364,7 +418,7 @@ def verify_checkpoint(path: str | os.PathLike) -> str | None:
                 f"shard {fname} corrupt: CRC32 {crc:#010x} on disk vs "
                 f"{rec['crc32']:#010x} in the manifest"
             )
-    return None
+    return _lineage.lineage_problem(path, meta)
 
 
 def latest_checkpoint(

@@ -63,8 +63,37 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           | ``worker_crash:stepN[:procP]``
                           | ``stall:stepN[:procP]``
                           | ``ckpt_corrupt:stepN[:shardS]``
-                          | ``ckpt_truncate:stepN[:shardS]``; several faults
-                          compose comma-separated (docs/robustness.md)
+                          | ``ckpt_truncate:stepN[:shardS]``
+                          | ``bit_flip:stepN[:field][:procP]`` — flip ONE
+                          mantissa bit (finite, NaN/Inf-guard-invisible;
+                          the silent-data-corruption twin of
+                          ``halo_corrupt``).  The optional third component
+                          is a FIELD NAME or a reserved placement:
+                          ``transport`` (flip a packed send-slab word in
+                          flight) or ``ckpt`` (flip serialized shard bytes
+                          after the lineage digest, before the write);
+                          several faults compose comma-separated
+                          (docs/robustness.md)
+``IGG_INTEGRITY``         silent-data-corruption integrity plane master
+                          switch (`implicitglobalgrid_tpu.integrity`,
+                          docs/robustness.md): ``1`` arms transport
+                          checksums on the host-entry coalesced halo
+                          exchange; ``0`` force-disables EVERY detector
+                          (checksums, shadow audit, env cadences) to a
+                          pinned zero-overhead path like
+                          ``IGG_TELEMETRY=0``; unset = checksums off but
+                          ``IGG_INTEGRITY_EVERY`` still honored.  Resolved
+                          host-side at the exchange entry / loop start
+                          (the knob-binding contract) — never read from
+                          traced code
+``IGG_INTEGRITY_EVERY``   shadow-step audit cadence in steps for the
+                          guarded time loops (int >= 0; 0/unset = off):
+                          every N committed steps `guarded_time_loop`
+                          re-executes the step from the retained pre-step
+                          state and bit-compares against the committed
+                          result; any difference raises
+                          `integrity.IntegrityError` naming the implicated
+                          rank.  Ignored when ``IGG_INTEGRITY=0``
 
 ``IGG_GATHER_BATCH``      blocks fetched per compiled dispatch in the
                           multi-host gather (int, clamped to >= 1, default
@@ -444,6 +473,24 @@ def fault_inject_env() -> str | None:
     """``IGG_FAULT_INJECT``: raw fault spec (parsed by `utils.resilience`)."""
     val = os.environ.get("IGG_FAULT_INJECT")
     return val or None
+
+
+def integrity_enabled_env() -> bool | None:
+    """``IGG_INTEGRITY``: integrity-plane master switch (tri-state).
+
+    ``None`` = unset (transport checksums off; ``IGG_INTEGRITY_EVERY``
+    still honored), ``False`` = ``0`` (every detector force-disabled —
+    the pinned zero-overhead path), ``True`` = armed.  Read host-side at
+    the exchange entry / loop construction, never from traced code.
+    """
+    val = _int_env("IGG_INTEGRITY")
+    return None if val is None else val > 0
+
+
+def integrity_every_env() -> int | None:
+    """``IGG_INTEGRITY_EVERY``: shadow-step audit cadence in steps
+    (>= 0; 0 = off).  Ignored when ``IGG_INTEGRITY=0``."""
+    return _int_env("IGG_INTEGRITY_EVERY", minimum=0)
 
 
 def coalesce_env() -> bool | None:

@@ -22,7 +22,9 @@ production stack ships first:
   (``init_flake:N``, ``halo_corrupt:stepN[:blockB]``,
   ``worker_crash:stepN[:procP]``, ``stall:stepN[:procP]``,
   ``net_delay:stepN[:procP]``, ``ckpt_corrupt:stepN[:shardS]``,
-  ``ckpt_truncate:stepN[:shardS]``; several compose comma-separated via
+  ``ckpt_truncate:stepN[:shardS]``,
+  ``bit_flip:stepN[:field|transport|ckpt][:procP]``; several compose
+  comma-separated via
   `FaultSet`, and ``chaos:seed=N:rate=R[:steps=M][:kinds=a+b]`` expands
   into a deterministic randomized storm over those kinds —
   `chaos_schedule`) so the 2-process `test_distributed.py` path and
@@ -457,6 +459,7 @@ FAULT_KINDS = (
     "net_delay",
     "ckpt_corrupt",
     "ckpt_truncate",
+    "bit_flip",
 )
 
 #: third spec component's prefix per fault kind (e.g. ``halo_corrupt:step3:block5``)
@@ -467,10 +470,19 @@ _TARGET_PREFIX = {
     "net_delay": "proc",
     "ckpt_corrupt": "shard",
     "ckpt_truncate": "shard",
+    "bit_flip": "proc",
 }
 
-#: kinds the seeded chaos schedule samples from (init_flake excluded: it
-#: fires during bring-up, outside the per-step storm the schedule models)
+#: ``bit_flip``'s reserved (non-field-name) placement components
+BIT_FLIP_PLACEMENTS = ("transport", "ckpt")
+
+#: kinds the seeded chaos schedule samples from by default (init_flake
+#: excluded: it fires during bring-up, outside the per-step storm the
+#: schedule models; bit_flip excluded from the DEFAULT draw because it is
+#: guard-invisible — a storm that lands one in a run without the integrity
+#: plane armed silently falsifies the result instead of exercising recovery.
+#: ``kinds=…+bit_flip`` opts a storm in explicitly when ``IGG_INTEGRITY``
+#: detectors are armed.)
 CHAOS_KINDS = (
     "worker_crash",
     "stall",
@@ -627,6 +639,20 @@ class FaultInjector:
       verification + generation fallback of `utils.checkpoint`.
     * ``ckpt_truncate:stepN[:shardS]`` — same, but the shard file is
       truncated to half its size (a torn write).
+    * ``bit_flip:stepN[:field|transport|ckpt][:procP]`` — silent data
+      corruption: ONE mantissa LSB flips, producing a perfectly FINITE
+      wrong value that `check_fields` can never see (``halo_corrupt`` is
+      its guard-VISIBLE twin — same injection point, NaN payload).  The
+      optional placement component picks the detector under test: a FIELD
+      NAME (or omitted: field 0) flips an interior cell of the committed
+      post-step state — caught only by the shadow-step audit
+      (``IGG_INTEGRITY_EVERY``); ``transport`` arms a payload-word flip on
+      rank ``P``'s next checksummed halo hop (`ops.halo.
+      arm_transport_flip`) — caught by the RECEIVER's transport checksum,
+      implicating the sender; ``ckpt`` flips one payload byte after the
+      lineage digests are taken but before the shard writer runs — CRC
+      verifies clean (the bytes on disk are intact), only the lineage
+      chain convicts the generation as poisoned-at-save.
 
     Each fault fires once per injector (a rolled-back or restarted run does
     not re-trip), mirroring how real transient faults behave.  Several
@@ -640,6 +666,8 @@ class FaultInjector:
     target: int | None = None  # halo_corrupt: block rank; worker_crash: process
     count: int = 0  # init_flake: remaining flaky attempts
     fired: bool = False
+    #: bit_flip placement: a field NAME, "transport", "ckpt", or None (field 0)
+    field: str | None = None
 
     #: exit status of an injected worker crash (distinct from real crashes)
     CRASH_STATUS = 17
@@ -658,6 +686,8 @@ class FaultInjector:
         if self.kind == "init_flake":
             return f"init_flake:{self.count}"
         out = f"{self.kind}:step{self.step}"
+        if self.kind == "bit_flip" and self.field is not None:
+            out += f":{self.field}"
         if self.target is not None:
             out += f":{_TARGET_PREFIX[self.kind]}{self.target}"
         return out
@@ -684,6 +714,51 @@ class FaultInjector:
                 )
             return cls(kind=kind, count=int(parts[1]))
         tgt_prefix = _TARGET_PREFIX[kind]
+        if kind == "bit_flip":
+            if len(parts) not in (2, 3, 4) or not parts[1].startswith("step"):
+                raise ValueError(
+                    f"IGG_FAULT_INJECT: {spec!r} — bit_flip takes "
+                    f"'bit_flip:stepN[:field|transport|ckpt][:procP]' with N "
+                    f"the 1-based time-loop step."
+                )
+            try:
+                step = int(parts[1][len("step"):])
+            except ValueError:
+                raise ValueError(
+                    f"IGG_FAULT_INJECT: {spec!r} — step must be an integer, "
+                    f"got {parts[1][len('step'):]!r}."
+                )
+            field = None
+            target = None
+            for comp in parts[2:]:
+                if comp.startswith("proc") and comp[len("proc"):].isdigit():
+                    if target is not None:
+                        raise ValueError(
+                            f"IGG_FAULT_INJECT: {spec!r} — bit_flip takes at "
+                            f"most one 'procP' component."
+                        )
+                    target = int(comp[len("proc"):])
+                elif comp.isdigit():
+                    # A bare integer is ambiguous (field index? rank?) and
+                    # would silently mis-read as a target on the other
+                    # kinds' grammar — demand the explicit form.
+                    raise ValueError(
+                        f"IGG_FAULT_INJECT: {spec!r} — bare integer "
+                        f"{comp!r} is not a bit_flip placement; name the "
+                        f"FIELD (e.g. ':T'), a reserved placement "
+                        f"(':transport' or ':ckpt'), or the target rank as "
+                        f"':proc{comp}'."
+                    )
+                elif field is None:
+                    field = comp
+                else:
+                    raise ValueError(
+                        f"IGG_FAULT_INJECT: {spec!r} — bit_flip takes at "
+                        f"most one placement component (field name, "
+                        f"'transport' or 'ckpt'); got both {field!r} and "
+                        f"{comp!r}."
+                    )
+            return cls(kind=kind, step=step, target=target, field=field)
         if len(parts) not in (2, 3) or not parts[1].startswith("step"):
             raise ValueError(
                 f"IGG_FAULT_INJECT: {spec!r} — {kind} takes "
@@ -903,6 +978,134 @@ class FaultInjector:
             flush=True,
         )
 
+    # - bit_flip -
+
+    def _bit_flip_armed(self, step: int, placement: str | None) -> bool:
+        """Does this injector's bit_flip fire at ``step`` for ``placement``
+        (None = the state placement: any non-reserved ``field``)?"""
+        if self.kind != "bit_flip" or self.fired or step != self.step:
+            return False
+        if placement is None:
+            return self.field not in BIT_FLIP_PLACEMENTS
+        return self.field == placement
+
+    def maybe_bit_flip(self, state: tuple, step: int,
+                       names: Sequence[str] | None = None) -> tuple:
+        """State placement: after step ``step``, flip ONE mantissa LSB of an
+        interior cell of the committed state — a finite wrong value, by
+        construction invisible to the NaN/Inf guard (``halo_corrupt`` is the
+        guard-visible twin).  Only the shadow-step audit can convict it.
+        Runs identically on every process (same scatter, same global index),
+        like `maybe_corrupt`.
+        """
+        if not self._bit_flip_armed(step, None):
+            return state
+        fidx = 0
+        if self.field is not None:
+            if names is None or self.field not in tuple(names):
+                have = ", ".join(map(repr, names)) if names else "(unnamed)"
+                raise ValueError(
+                    f"IGG_FAULT_INJECT(bit_flip): field {self.field!r} does "
+                    f"not exist in this run — the guarded state carries "
+                    f"{have}. The spec is 'bit_flip:stepN[:field|transport|"
+                    f"ckpt][:procP]'; a field component must name one of the "
+                    f"run's fields."
+                )
+            fidx = tuple(names).index(self.field)
+        self.fired = True
+        A = self._flip_state_cell(state[fidx], step, fidx)
+        return (*state[:fidx], A, *state[fidx + 1:])
+
+    def _flip_state_cell(self, A, step: int, fidx: int):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.gather import _word_dtype
+
+        idx = _block_interior_index(A, self.target or 0)
+        _telemetry.event(
+            "fault.bit_flip", step=step, placement="state",
+            field=self.field or f"field{fidx}",
+            index=list(int(i) for i in idx), proc=self.target or 0,
+        )
+        if _safe_process_index() == 0:
+            print(
+                f"[igg.resilience] IGG_FAULT_INJECT(bit_flip): flipping one "
+                f"mantissa bit at global index {tuple(idx)} "
+                f"(field {self.field or fidx}, block {self.target or 0}) "
+                f"after step {step}",
+                file=sys.stderr,
+                flush=True,
+            )
+        val = A[idx]
+        if jnp.issubdtype(A.dtype, jnp.floating):
+            word = lax.bitcast_convert_type(val, _word_dtype(A.dtype))
+            new = lax.bitcast_convert_type(
+                word ^ np.array(1, word.dtype), A.dtype
+            )
+        else:
+            new = val ^ np.array(1, A.dtype)
+        return A.at[idx].set(new)
+
+    def maybe_bit_flip_transport(self, step: int) -> None:
+        """Transport placement: arm a payload-word flip on rank ``P``'s next
+        checksummed halo hop (`ops.halo.arm_transport_flip`) — the
+        arm-on-step / fire-on-next-collective idiom of ``net_delay``.  Every
+        process arms (the flip is rank-conditional INSIDE the traced
+        program), so the SPMD build stays identical on all ranks."""
+        if not self._bit_flip_armed(step, "transport"):
+            return
+        self.fired = True
+        from ..ops import halo as _halo
+
+        want = self.target if self.target is not None else 0
+        _telemetry.event(
+            "fault.bit_flip", step=step, placement="transport", proc=want
+        )
+        if _safe_process_index() == 0:
+            print(
+                f"[igg.resilience] IGG_FAULT_INJECT(bit_flip): arming an "
+                f"in-flight payload-word flip on rank {want}'s next "
+                f"checksummed halo transport (after step {step})",
+                file=sys.stderr,
+                flush=True,
+            )
+        _halo.arm_transport_flip(want)
+
+    def maybe_bit_flip_ckpt(self, payload: dict, step: int) -> None:
+        """Checkpoint placement: flip one payload byte AFTER the lineage
+        digests were taken and BEFORE the shard writer runs (`utils.
+        checkpoint._save_checkpoint` calls this between the two).  The CRC
+        manifest then vouches for the flipped bytes — the file on disk is
+        intact — and only the lineage chain convicts the generation as
+        poisoned-at-save.  Mutates the payload dict's arrays in place; the
+        writer process ``P`` (default 0) applies it."""
+        if not self._bit_flip_armed(step, "ckpt"):
+            return
+        want = self.target if self.target is not None else 0
+        if _safe_process_index() != want:
+            return
+        self.fired = True
+        keys = sorted(k for k in payload if not k.endswith("_shape"))
+        if not keys:
+            return
+        # copy=True: the payload entries are zero-copy views of the live
+        # device buffers and arrive read-only
+        arr = np.array(payload[keys[0]], copy=True)
+        arr.view(np.uint8).reshape(-1)[0] ^= 1
+        payload[keys[0]] = arr
+        _telemetry.event(
+            "fault.bit_flip", step=step, placement="ckpt", key=keys[0],
+            proc=want,
+        )
+        print(
+            f"[igg.resilience] IGG_FAULT_INJECT(bit_flip): flipped one "
+            f"payload byte of {keys[0]} between digest and write of the "
+            f"step-{step} checkpoint",
+            file=sys.stderr,
+            flush=True,
+        )
+
 
 @dataclasses.dataclass
 class FaultSet:
@@ -959,6 +1162,20 @@ class FaultSet:
     def maybe_net_delay(self, step: int) -> None:
         for i in self.injectors:
             i.maybe_net_delay(step)
+
+    def maybe_bit_flip(self, state: tuple, step: int,
+                       names: Sequence[str] | None = None) -> tuple:
+        for i in self.injectors:
+            state = i.maybe_bit_flip(state, step, names)
+        return state
+
+    def maybe_bit_flip_transport(self, step: int) -> None:
+        for i in self.injectors:
+            i.maybe_bit_flip_transport(step)
+
+    def maybe_bit_flip_ckpt(self, payload: dict, step: int) -> None:
+        for i in self.injectors:
+            i.maybe_bit_flip_ckpt(payload, step)
 
     def specs(self) -> list[str]:
         """Canonical per-fault spec strings (the supervisor round-trip)."""
@@ -1062,9 +1279,10 @@ def guarded_time_loop(step_fn, state: tuple, nt: int, *, guard: "RunGuard",
 
     Resumes from the guard's checkpoint dir when one exists, then advances
     to step ``nt``, running `RunGuard.on_step` after every step (fault
-    injection → NaN/Inf guard → checkpoint → crash injection; rollback may
-    rewind the loop variable).  Shared by the three models' ``run()`` so the
-    guard semantics cannot drift between them.
+    injection → shadow-step audit at the ``integrity_every`` cadence →
+    NaN/Inf guard → checkpoint → crash injection; rollback may rewind the
+    loop variable).  Shared by the three models' ``run()`` so the guard
+    semantics cannot drift between them.
 
     ``model`` switches on the per-step telemetry (docs/observability.md):
     wall time, steps/s and — with ``bytes_per_step`` (the solver's
@@ -1144,13 +1362,17 @@ def _guarded_loop_body(step_fn, state, nt, it, guard, enabled,
             span = _tracing.trace_span("igg.step", model=model, step=it + 1)
             ann = trace_annotation(f"igg_step[{model}]")
         with span:
+            # Shadow-audit retention (docs/robustness.md): off-cadence steps
+            # pay one `is not None`-style check; on-cadence steps snapshot
+            # the pre-step state the audit re-executes from.
+            pre = guard.audit_snapshot(state, it) if enabled else None
             with ann:
                 state = step_fn(*state)
             if sync_every_step:
                 jax.block_until_ready(state)
             it += 1
             if enabled:
-                state, it = guard.on_step(state, it)
+                state, it = guard.on_step(state, it, replay=(step_fn, pre))
         if tele is not None:
             tele.on_step(it)
     if tele is not None:
@@ -1172,21 +1394,30 @@ class RunGuard:
             it += 1
             state, it = guard.on_step(state, it)
 
-    Per step, in order: (1) fault injection (``halo_corrupt``), (2) the
-    NaN/Inf guard every ``guard_every`` steps with the ``raise`` | ``warn``
-    | ``rollback`` policy, (3) checkpoint every ``checkpoint_every`` steps
-    (only ever of guard-passed state) followed by retention pruning when
-    ``checkpoint_keep`` (``IGG_CHECKPOINT_KEEP``) is set — pruning never
-    deletes the only integrity-verified generation, (4) fault injection
-    (``worker_crash`` — after the checkpoint, so restart resumes exactly at
-    the crash point — and ``stall``).  Rollback restores the last good
+    Per step, in order: (1) fault injection (``halo_corrupt``, ``bit_flip``),
+    (2) the shadow-step audit every ``integrity_every`` steps
+    (``IGG_INTEGRITY_EVERY``): the loop retained a pre-step snapshot
+    (`audit_snapshot`), the just-committed step re-executes from it and the
+    two results bit-compare (`integrity.audit_fields`) — a mismatch raises
+    `integrity.IntegrityError` BEFORE any checkpoint can persist the corrupt
+    state, with a ``reason=sdc`` flight bundle naming the implicated
+    rank(s), (3) the NaN/Inf guard every ``guard_every`` steps with the
+    ``raise`` | ``warn`` | ``rollback`` policy, (4) checkpoint every
+    ``checkpoint_every`` steps (only ever of guard-passed state) followed by
+    retention pruning when ``checkpoint_keep`` (``IGG_CHECKPOINT_KEEP``) is
+    set — pruning never deletes the only integrity-verified generation,
+    (5) fault injection (``worker_crash`` — after the checkpoint, so restart
+    resumes exactly at the crash point — ``stall``, ``net_delay``, and the
+    ``bit_flip`` transport arming).  Rollback restores the last good
     snapshot (in-memory; the disk checkpoint serves cross-process restart)
     and rewinds ``it``.  A pending CRITICAL live-plane alert (`on_alert`,
-    subscribed by `guarded_time_loop`) forces the step-(2) probe out of
+    subscribed by `guarded_time_loop`) forces the step-(3) probe out of
     cadence at the next step.
 
     All knobs resolve kwarg > ``IGG_*`` env > default (the reference's
-    configuration tiers).
+    configuration tiers); ``IGG_INTEGRITY=0`` force-disables the audit
+    cadence regardless of either tier (the pinned zero-overhead switch,
+    like ``IGG_TELEMETRY=0``).
     """
 
     def __init__(
@@ -1200,12 +1431,14 @@ class RunGuard:
         names: Sequence[str] | None = None,
         max_rollbacks: int = 3,
         injector: "FaultInjector | FaultSet | None" = None,
+        integrity_every: int | None = None,
     ):
         env_ge = _config.guard_every_env()
         env_pol = _config.guard_policy_env()
         env_ce = _config.checkpoint_every_env()
         env_dir = _config.checkpoint_dir_env()
         env_keep = _config.checkpoint_keep_env()
+        env_ie = _config.integrity_every_env()
         self.guard_every = int(
             guard_every if guard_every is not None else (env_ge or 0)
         )
@@ -1222,6 +1455,18 @@ class RunGuard:
         self.checkpoint_keep = int(
             checkpoint_keep if checkpoint_keep is not None else (env_keep or 0)
         )
+        # Shadow-step audit cadence (docs/robustness.md).  ``IGG_INTEGRITY=0``
+        # overrides BOTH tiers to 0: the master switch pins the whole
+        # integrity plane to zero overhead, whatever a cadence knob says.
+        self.integrity_every = int(
+            integrity_every if integrity_every is not None else (env_ie or 0)
+        )
+        if _config.integrity_enabled_env() is False:
+            self.integrity_every = 0
+        if self.integrity_every < 0:
+            raise ValueError(
+                f"integrity_every must be >= 0 (got {self.integrity_every})"
+            )
         if self.guard_every < 0:
             raise ValueError(f"guard_every must be >= 0 (got {self.guard_every})")
         if self.checkpoint_every < 0:
@@ -1257,7 +1502,10 @@ class RunGuard:
     @property
     def enabled(self) -> bool:
         return bool(
-            self.guard_every or self.checkpoint_every or self._injector.active
+            self.guard_every
+            or self.checkpoint_every
+            or self.integrity_every
+            or self._injector.active
         )
 
     def start(self, state: tuple) -> tuple:
@@ -1300,9 +1548,28 @@ class RunGuard:
         if alert.get("severity") == "critical":
             self._alert = alert
 
-    def on_step(self, state: tuple, it: int) -> tuple:
-        """Run the per-step guard pipeline; returns ``(state, it)``."""
+    def audit_snapshot(self, state: tuple, it: int) -> tuple | None:
+        """Pre-step state retained for the shadow audit of step ``it + 1``,
+        or None when that step is off-cadence.  Called by the loop BEFORE the
+        step executes; the snapshot owns fresh buffers (`snapshot_state`), so
+        donating step functions can consume it in the re-execution."""
+        if not self.integrity_every or (it + 1) % self.integrity_every != 0:
+            return None
+        return snapshot_state(state)
+
+    def on_step(self, state: tuple, it: int, replay=None) -> tuple:
+        """Run the per-step guard pipeline; returns ``(state, it)``.
+
+        ``replay``: ``(step_fn, pre_state_or_None)`` from the loop — when the
+        retained `audit_snapshot` is present, step ``it`` re-executes from it
+        and bit-compares against the committed ``state`` (the shadow-step
+        audit).  Injection runs FIRST, so an armed state-placement
+        ``bit_flip`` lands in the committed copy and the clean re-execution
+        convicts it — the detection matrix's compute-placement leg."""
         state = self._injector.maybe_corrupt(state, it)
+        state = self._injector.maybe_bit_flip(state, it, self.names)
+        if replay is not None and replay[1] is not None:
+            state = self._audit(state, it, replay)
         escalated, self._alert = self._alert, None
         if escalated is not None and _last_process_index() > 0:
             # Multi-process grid: `check_fields` is a COLLECTIVE, and an
@@ -1345,7 +1612,51 @@ class RunGuard:
         self._injector.maybe_crash(it)
         self._injector.maybe_stall(it)
         self._injector.maybe_net_delay(it)
+        self._injector.maybe_bit_flip_transport(it)
         return state, it
+
+    def _audit(self, state: tuple, it: int, replay) -> tuple:
+        """The shadow-step audit of step ``it`` (docs/robustness.md).
+
+        Re-executes the step from the retained pre-step snapshot and
+        bit-compares against the committed result.  Healthy hardware is
+        run-to-run deterministic under XLA, so ANY difference is silent data
+        corruption; the verdict is replicated (`integrity.audit_fields`), so
+        every rank raises together — no rank-local collective divergence.
+        Raises BEFORE the checkpoint stage so corrupt state never persists.
+        """
+        from ..integrity import audit_fields
+        from ..integrity.errors import IntegrityError
+
+        step_fn, pre = replay
+        redone = step_fn(*pre)
+        report = audit_fields(tuple(state), tuple(redone), names=self.names)
+        _telemetry.counter("integrity.audits").inc()
+        if report.ok:
+            return state
+        _telemetry.counter("integrity.audit_mismatches").inc()
+        _telemetry.event(
+            "integrity.audit_mismatch", detector="shadow_audit", step=it,
+            report=report.summary(),
+            implicated_ranks=list(report.implicated_ranks),
+        )
+        implicated = (
+            report.implicated_ranks[0] if report.implicated_ranks else -1
+        )
+        _tracing.dump_flight_recorder(
+            "sdc", detector="shadow_audit", step=it,
+            implicated_rank=implicated,
+            implicated_ranks=list(report.implicated_ranks),
+            report=report.summary(),
+        )
+        raise IntegrityError(
+            f"shadow-step audit mismatch at step {it}: {report.summary()}. "
+            f"The committed step and its re-execution from identical inputs "
+            f"differ bitwise — silent data corruption on the implicated "
+            f"rank(s); quarantine them (restart-in-place re-runs the lying "
+            f"core).",
+            detector="shadow_audit", implicated_rank=implicated, step=it,
+        )
 
     def _trip(self, state: tuple, it: int, report: FieldReport) -> tuple:
         msg = f"NaN/Inf guard tripped at step {it}: {report.summary()}"

@@ -97,16 +97,39 @@ telemetry snapshot instead of private tallies.
   the detect → classify → recover event ORDER verified from the per-rank
   ``events.jsonl`` timeline.
 
-The ``elastic_failover``, ``frontdoor`` and ``chaos`` scenarios are thin
-wrappers over `igg.supervisor` — the spawn/watch/classify/relaunch logic
-lives in the package, the drills keep only their load generators and
-acceptance checks.
+* ``sdc`` — the silent-data-corruption drill (ISSUE 18,
+  docs/robustness.md): a deterministic ``bit_flip`` storm through every
+  tier of the integrity plane (``IGG_INTEGRITY=1``,
+  ``IGG_INTEGRITY_EVERY=1``) over a supervised 2-process gloo pair
+  running the HOST-path step the transport checksums cover.  One flip
+  per placement, each caught by exactly its intended detector:
+  (a) a transport-placement flip on rank 0's wire trips the RECEIVER's
+  checksum check on rank 1, whose ``reason="sdc"`` flight bundle
+  implicates the SENDER — the supervisor classifies
+  ``silent_corruption`` and quarantines rank 0 on the FIRST offense;
+  (b) a state-placement flip in the shrunk restart is caught by the
+  shadow-step audit BEFORE the corrupt state reaches a checkpoint —
+  second quarantine; (c) a checkpoint-placement flip (CRC-clean, flipped
+  AFTER the lineage digests) poisons a generation silently, a crash
+  follows, and the relaunch's lineage verification convicts the poisoned
+  generation and falls back past it (``checkpoint.fallback``).
+  Acceptance: the detector → classify → quarantine chain in order for
+  both in-flight detectors, the final de-duplicated field BIT-IDENTICAL
+  to an undisturbed oracle, and the oracle doubling as the clean leg —
+  the whole plane armed, ZERO false positives (audits > 0, mismatch
+  counters pinned at 0 in its `igg.dump_metrics` record).
+
+The ``elastic_failover``, ``frontdoor``, ``chaos`` and ``sdc`` scenarios
+are thin wrappers over `igg.supervisor` — the spawn/watch/classify/
+relaunch logic lives in the package, the drills keep only their load
+generators and acceptance checks.
 
 ``--quick`` runs the ``elastic_failover`` drill, the ``serving`` smoke,
-the ``live_plane`` drill, the ``frontdoor`` drill, the ``chaos`` storm
-and the ``fleet`` drill (multi-pool failure domains behind one
-health-routed door + SLO-gated canary rollout, ISSUE 16) at small size —
-the fast smoke path (registered next to the tier-1 command in
+the ``live_plane`` drill, the ``frontdoor`` drill, the ``chaos`` storm,
+the ``fleet`` drill (multi-pool failure domains behind one
+health-routed door + SLO-gated canary rollout, ISSUE 16) and the ``sdc``
+drill (bit-flip storm through the integrity plane, ISSUE 18) at small
+size — the fast smoke path (registered next to the tier-1 command in
 docs/testing.md).  Scenarios can also be named positionally:
 ``python scripts/soak.py chaos --quick`` runs just the chaos drill at
 quick sizing; ``--list`` prints every scenario with a one-line
@@ -128,7 +151,7 @@ CRASH_STATUS = 17   # FaultInjector.CRASH_STATUS
 RESIZE_STATUS = 19  # serving.frontdoor.RESIZE_STATUS
 SCENARIOS = ("init_flake", "halo_corrupt", "worker_crash",
              "elastic_failover", "serving", "live_plane", "frontdoor",
-             "chaos", "fleet")
+             "chaos", "fleet", "sdc")
 SCENARIO_DESCRIPTIONS = {
     "init_flake": "transient init failure -> bounded retry, result == baseline",
     "halo_corrupt": "injected halo corruption -> guard trip + checkpoint rollback",
@@ -139,6 +162,8 @@ SCENARIO_DESCRIPTIONS = {
     "frontdoor": "HTTP load + stall backpressure + elastic scale-up/down, digests == oracle",
     "chaos": "seeded multi-fault storm through the self-healing supervisor",
     "fleet": "chaos-killed pool re-routed behind one door + SLO-gated canary rollout",
+    "sdc": "bit-flip storm: every integrity detector trips, liars quarantined, "
+           "poisoned generation skipped, clean leg pins zero false positives",
 }
 
 
@@ -276,6 +301,101 @@ def child_elastic_main(args) -> int:
     # Per-rank span file into IGG_TELEMETRY_DIR (no-op when unarmed): the
     # orchestrator merges and validates the Chrome trace (--quick gate).
     igg.dump_trace()
+    igg.finalize_global_grid()
+    print("SOAK CHILD OK", flush=True)
+    return 0
+
+
+def child_sdc_main(args) -> int:
+    """One worker of the sdc drill: a guarded diffusion-like run whose
+    exchange goes through the HOST-path `igg.update_halo` entry — the
+    surface the transport checksums cover (the models' fused steps trace
+    the exchange inside the jitted program, where the in-program variant
+    carries no checksum words).  ``--nproc 2`` = one member of the gloo
+    pair (dims (2,1,1), local ``nx^3``); ``--nproc 1`` = the
+    single-process topology spanning the SAME implicit global grid — the
+    oracle/clean leg, or the shrunk quarantine restart."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    pid = args.pair_id
+    if args.nproc > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.utils import resilience
+
+    resilience.arm_watchdog(max(30, args.timeout - 40), exit=True)
+    if args.nproc > 1:
+        nxyz = (args.nx, args.nx, args.nx)
+        grid_kwargs = dict(
+            init_distributed=True,
+            distributed_kwargs=dict(
+                coordinator_address=f"127.0.0.1:{args.port}",
+                num_processes=args.nproc,
+                process_id=pid,
+            ),
+        )
+    else:
+        # same nxyz_g as the pair's (2,1,1) decomposition: 2*(nx-2)+2
+        nxyz = (2 * args.nx - 2, args.nx, args.nx)
+        grid_kwargs = {}
+    igg.init_global_grid(*nxyz, quiet=(pid != 0), **grid_kwargs)
+
+    # The diffusion model's initial condition under a hand-rolled step:
+    # jitted per-block interior update (`igg.stencil`), then the
+    # checksummed global exchange on the committed fields.  The update is
+    # functional on the PRE-step values and the halos entering step k hold
+    # step k-1's committed neighbor planes, so the 2-process and 1-process
+    # topologies stay bit-identical in dedup space — the cross-topology
+    # resume the quarantine ladder depends on.
+    state, _params = diffusion3d.setup(*nxyz, init_grid=False)
+
+    @igg.stencil
+    def interior(T, Cp):
+        avg = (
+            T[:-2, 1:-1, 1:-1] + T[2:, 1:-1, 1:-1]
+            + T[1:-1, :-2, 1:-1] + T[1:-1, 2:, 1:-1]
+            + T[1:-1, 1:-1, :-2] + T[1:-1, 1:-1, 2:]
+        ) / 6.0
+        mid = T[1:-1, 1:-1, 1:-1]
+        T = T.at[1:-1, 1:-1, 1:-1].set(
+            mid + 0.1 * Cp[1:-1, 1:-1, 1:-1] * (avg - mid)
+        )
+        return T, Cp
+
+    def step(T, Cp):
+        T, Cp = interior(T, Cp)
+        return igg.update_halo(T, Cp)  # HOST path: the checksummed plane
+
+    guard = resilience.RunGuard(
+        checkpoint_every=2 if args.ckpt_dir else 0,
+        checkpoint_dir=args.ckpt_dir,
+        names=("T", "Cp"),
+    )
+    from implicitglobalgrid_tpu.utils.telemetry import teff_bytes
+
+    state = resilience.guarded_time_loop(
+        step, state, args.steps, guard=guard, sync_every_step=True,
+        model="diffusion3d", bytes_per_step=teff_bytes(state[:1]),
+    )
+    T = diffusion3d.temperature(state)
+    dd = igg.gather(T, dedup=True, root=0)
+    if jax.process_index() == 0:
+        assert dd is not None and np.isfinite(dd).all()
+        np.save(args.out, dd)
+        # counters the orchestrator's clean-leg acceptance reads:
+        # integrity.audits > 0, *_mismatches == 0
+        igg.dump_metrics(args.out + ".metrics")
     igg.finalize_global_grid()
     print("SOAK CHILD OK", flush=True)
     return 0
@@ -1224,6 +1344,16 @@ def _elastic_cmd(args, *, nproc, pair_id, port, ckpt, out, expect_resume=-1):
     ]
 
 
+def _sdc_cmd(args, *, nproc, pair_id, port, ckpt, out):
+    return [
+        sys.executable, os.path.abspath(__file__), "--sdc-child",
+        "--steps", str(args.steps), "--nx", str(args.nx),
+        "--nproc", str(nproc), "--pair-id", str(pair_id),
+        "--port", str(port), "--timeout", str(args.timeout),
+        "--ckpt-dir", ckpt or "", "--out", out or "",
+    ]
+
+
 def _elastic_env(env_extra: dict) -> dict:
     env = dict(os.environ)
     env.pop("IGG_FAULT_INJECT", None)
@@ -1720,6 +1850,249 @@ def supervise_chaos(args) -> bool:
     )
 
 
+def _verify_sdc_events(tele_dir: str) -> tuple[bool, str]:
+    """The sdc drill's machine-readable acceptance: each bit_flip
+    placement surfaced through exactly its intended detector, in order —
+    the transport trip (emitted by the RECEIVER, implicating the sender),
+    the shadow-audit trip, the lineage fallback past the poisoned
+    generation — and the recovered run completed."""
+    import glob
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu.utils.telemetry import read_events
+
+    files = sorted(glob.glob(os.path.join(tele_dir, "events*.jsonl")))
+    if not files:
+        return False, f"no events*.jsonl under {tele_dir}"
+    events = [e for f in files for e in read_events(f)]
+    if any(
+        "rank" not in e or not isinstance(e.get("ts"), (int, float))
+        for e in events
+    ):
+        return False, "event lines missing rank/ts tags"
+    events.sort(key=lambda e: e["ts"])
+    types = [str(e.get("type")) for e in events]
+    placements = sorted({
+        str(e.get("placement")) for e in events if e["type"] == "fault.bit_flip"
+    })
+    if placements != ["ckpt", "state", "transport"]:
+        return False, f"expected all three bit_flip placements, saw {placements}"
+    transport = [e for e in events if e["type"] == "integrity.transport_mismatch"]
+    if not transport:
+        return False, "the transport flip never tripped a receiver checksum"
+    if any(e.get("implicated_rank") != 0 for e in transport):
+        return False, (
+            f"transport trip implicated "
+            f"{sorted({e.get('implicated_rank') for e in transport})}, "
+            f"expected the armed sender rank 0"
+        )
+    if all(e.get("rank") != 1 for e in transport):
+        return False, "no transport trip was emitted by the RECEIVER rank 1"
+    milestones = (
+        ("transport trip", lambda e: e["type"] == "integrity.transport_mismatch"),
+        ("quarantine #1", lambda e: e["type"] == "supervisor.recover"
+         and e.get("action") == "quarantine"),
+        ("audit trip", lambda e: e["type"] == "integrity.audit_mismatch"),
+        ("quarantine #2", lambda e: e["type"] == "supervisor.recover"
+         and e.get("action") == "quarantine"),
+        ("ckpt flip", lambda e: e["type"] == "fault.bit_flip"
+         and e.get("placement") == "ckpt"),
+        ("crash", lambda e: e["type"] == "fault.worker_crash"),
+        ("lineage fallback", lambda e: e["type"] == "checkpoint.fallback"),
+        ("recovery", lambda e: e["type"] == "run.complete"),
+    )
+    i = 0
+    for name, pred in milestones:
+        while i < len(events) and not pred(events[i]):
+            i += 1
+        if i >= len(events):
+            seen = sorted(set(types))
+            return False, f"sdc timeline missing '{name}' (in order); saw {seen}"
+        i += 1
+    return True, (
+        f"{len(events)} events across {len(files)} file(s): transport trip "
+        f"(receiver rank 1 implicating sender rank 0) -> quarantine -> "
+        f"audit trip -> quarantine -> poisoned generation skipped by "
+        f"lineage fallback -> recovery"
+    )
+
+
+def supervise_sdc(args) -> bool:
+    """The silent-data-corruption drill (module docstring): one bit_flip
+    per integrity-plane placement over a supervised gloo pair running the
+    HOST-path step, each caught by exactly its intended detector, the
+    implicated rank quarantined on the first offense, the poisoned
+    checkpoint generation skipped on relaunch — and the final field
+    BIT-IDENTICAL to an undisturbed oracle that doubles as the clean leg
+    (whole plane armed, zero false positives)."""
+    import json
+    import shutil
+
+    import numpy as np
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from implicitglobalgrid_tpu import supervisor as sup
+
+    workdir = args.workdir
+    ckpt = os.path.join(workdir, "ckpt_sdc")
+    run_dir = os.path.join(workdir, "sdc_run")
+    tele_dir = os.path.join(workdir, "telemetry_sdc")
+    tele_clean = os.path.join(workdir, "telemetry_sdc_clean")
+    for d in (ckpt, run_dir, tele_dir, tele_clean):
+        shutil.rmtree(d, ignore_errors=True)
+    # the placements need distinct steps (cross-incarnation pruning is
+    # keyed on (kind, step)) and the ckpt flip needs a generation after
+    # the audit trip's resume point
+    steps = max(8, args.steps)
+    oargs = argparse.Namespace(**vars(args))
+    oargs.steps = steps
+    integrity = {"IGG_INTEGRITY": "1", "IGG_INTEGRITY_EVERY": "1"}
+
+    # (1) the undisturbed oracle IS the clean leg: 1-process topology,
+    # the WHOLE plane armed, its own telemetry dir — zero false positives
+    # is part of the acceptance (transport checksums + per-step audits
+    # must never trip on honest data)
+    oracle_out = os.path.join(workdir, "sdc_oracle.npy")
+    proc = _run_child(
+        _sdc_cmd(oargs, nproc=1, pair_id=0, port=0, ckpt=None,
+                 out=oracle_out),
+        _elastic_env({**integrity, "IGG_TELEMETRY": "1",
+                      "IGG_TELEMETRY_DIR": tele_clean}),
+        args.timeout,
+    )
+    if proc.returncode != 0:
+        print(proc.stdout, proc.stderr, sep="\n", file=sys.stderr)
+        return _report("sdc", False, f"clean leg rc={proc.returncode}")
+    try:
+        with open(oracle_out + ".metrics.json") as f:
+            counters = json.load(f).get("counters", {})
+    except (OSError, ValueError) as e:
+        return _report("sdc", False, f"clean-leg metrics unreadable ({e!r})")
+    false_pos = {
+        k: counters.get(k, 0)
+        for k in ("integrity.audit_mismatches",
+                  "integrity.transport_mismatches")
+        if counters.get(k, 0)
+    }
+    if not counters.get("integrity.audits"):
+        return _report("sdc", False, "clean leg ran zero shadow audits")
+    if false_pos:
+        return _report("sdc", False, f"clean-leg FALSE POSITIVES: {false_pos}")
+
+    # (2) the supervised bit-flip storm: transport flip on rank 0's wire
+    # (step 2 arm -> step 3 trip on rank 1), state flip at step 4 (fires
+    # only in the shrunk restart: the stranded sender is reaped while
+    # blocked in its step-3 audit replay), ckpt flip poisoning the
+    # step-6 generation, then a crash so the relaunch must walk past it
+    got_out = os.path.join(workdir, "sdc_resumed.npy")
+    launch = {"gen": None, "port": 0}
+
+    def command_for(rank, nranks, rung, gen):
+        if launch["gen"] != gen:
+            launch["gen"] = gen
+            launch["port"] = _free_port()
+        return _sdc_cmd(
+            oargs, nproc=nranks, pair_id=rank, port=launch["port"],
+            ckpt=ckpt, out=got_out,
+        )
+
+    rsup = sup.RunSupervisor(
+        command_for,
+        ladder=[2, 1, 1],  # two quarantine shrinks must not exhaust it
+        workdir=run_dir,
+        telemetry_dir=tele_dir,
+        policy=sup.RecoveryPolicy(max_restarts=1, backoff_s=0.2),
+        fault_spec=(
+            "bit_flip:step2:transport:proc0,bit_flip:step4:T,"
+            "bit_flip:step6:ckpt,worker_crash:step7:proc0"
+        ),
+        env={
+            "PYTHONPATH": _elastic_env({})["PYTHONPATH"],
+            **integrity,
+            "IGG_TELEMETRY": "1",
+            "IGG_METRICS_PORT": "0",
+            "IGG_HEARTBEAT_EVERY": "1",
+        },
+        grace_s=15.0,
+        poll_s=0.3,
+        name="sdc",
+    )
+    report = rsup.run(timeout=args.timeout, max_incarnations=6)
+    if not report.ok:
+        _dump_run_logs(run_dir)
+        return _report("sdc", False, f"supervisor: {report.summary()}")
+
+    # (3) the escalation chain: detector -> silent_corruption ->
+    # first-offense quarantine, for BOTH in-flight detectors
+    sdc_inc = [i for i in report.incidents if i["kind"] == "silent_corruption"]
+    detectors = [i["detail"].get("detector") for i in sdc_inc]
+    if detectors != ["transport_checksum", "shadow_audit"]:
+        return _report(
+            "sdc", False,
+            f"expected transport_checksum then shadow_audit convictions, "
+            f"got {detectors} (kinds "
+            f"{[i['kind'] for i in report.incidents]})",
+        )
+    if any(i["decision"]["action"] != "quarantine" for i in sdc_inc):
+        return _report(
+            "sdc", False,
+            f"silent_corruption must quarantine on the FIRST offense, got "
+            f"{[i['decision']['action'] for i in sdc_inc]}",
+        )
+    transport_inc = sdc_inc[0]
+    if (transport_inc["detail"].get("implicated_rank") != 0
+            or transport_inc["detail"].get("bundle_rank") != 1):
+        return _report(
+            "sdc", False,
+            f"transport conviction must come from the RECEIVER's bundle "
+            f"(rank 1) and implicate the SENDER (rank 0), got detail "
+            f"{transport_inc['detail']}",
+        )
+    if 0 not in report.quarantined:
+        return _report(
+            "sdc", False, f"rank 0 not quarantined ({report.quarantined})"
+        )
+    crash_actions = [
+        i["decision"]["action"] for i in report.incidents
+        if i["kind"] == "crash"
+    ]
+    if crash_actions != ["restart"]:
+        return _report(
+            "sdc", False,
+            f"the post-poisoning crash should restart in place, got "
+            f"{crash_actions}",
+        )
+
+    # (4) bit-identity in dedup space vs the undisturbed oracle
+    oracle = np.load(oracle_out)
+    got = np.load(got_out)
+    if got.shape != oracle.shape or not np.array_equal(got, oracle):
+        detail = (
+            "shape mismatch" if got.shape != oracle.shape
+            else f"max |err| {np.max(np.abs(got - oracle))}"
+        )
+        return _report(
+            "sdc", False,
+            f"final dedup field differs from the oracle ({detail})",
+        )
+
+    # (5) the event-order acceptance
+    ev_ok, ev_detail = _verify_sdc_events(tele_dir)
+    if not ev_ok:
+        return _report("sdc", False, f"events: {ev_detail}")
+    kinds = [i["kind"] for i in report.incidents]
+    actions = [i["decision"]["action"] for i in report.incidents]
+    return _report(
+        "sdc", True,
+        f"{' -> '.join(f'{k}/{a}' for k, a in zip(kinds, actions))} across "
+        f"{report.generations + 1} generation(s), clean leg pinned zero "
+        f"false positives, final field bit-identical to the oracle; "
+        f"{ev_detail}",
+    )
+
+
 def _dump_fleet_logs(fleet_dir: str) -> None:
     import glob as _glob
 
@@ -2057,7 +2430,7 @@ def orchestrate(args) -> int:
     baseline = None
     if any(
         s not in ("elastic_failover", "serving", "live_plane", "frontdoor",
-                  "chaos", "fleet")
+                  "chaos", "fleet", "sdc")
         for s in args.scenarios
     ):
         proc, base_out, _ = _spawn_child(args, "baseline", args.workdir, {})
@@ -2087,6 +2460,10 @@ def orchestrate(args) -> int:
             continue
         if scenario == "fleet":
             if not supervise_fleet(args):
+                failures += 1
+            continue
+        if scenario == "sdc":
+            if not supervise_sdc(args):
                 failures += 1
             continue
         if scenario == "serving":
@@ -2192,8 +2569,9 @@ def main() -> int:
         "the batched-serving loop smoke (mid-flight admit/retire, "
         "per-member convergence masking), the live_plane drill "
         "(mid-run endpoint scrape + stall alert) and the frontdoor drill "
-        "(HTTP load + stall backpressure + elastic scale-up/down) and the "
-        "fleet drill (chaos-killed pool re-routed + canary rollout) at "
+        "(HTTP load + stall backpressure + elastic scale-up/down), the "
+        "fleet drill (chaos-killed pool re-routed + canary rollout) and "
+        "the sdc drill (bit-flip storm through the integrity plane) at "
         "small size — the CI lane registered in docs/testing.md",
     )
     ap.add_argument(
@@ -2203,6 +2581,7 @@ def main() -> int:
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--elastic-child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--sdc-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--serving-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--live-child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--frontdoor-child", action="store_true", help=argparse.SUPPRESS)
@@ -2229,6 +2608,8 @@ def main() -> int:
         return 0
     if args.elastic_child:
         return child_elastic_main(args)
+    if args.sdc_child:
+        return child_sdc_main(args)
     if args.serving_child:
         return child_serving_main(args)
     if args.live_child:
@@ -2250,7 +2631,7 @@ def main() -> int:
             args.timeout = min(args.timeout, 300)
     elif args.quick:
         args.scenarios = ["elastic_failover", "serving", "live_plane",
-                          "frontdoor", "chaos", "fleet"]
+                          "frontdoor", "chaos", "fleet", "sdc"]
         args.steps = min(args.steps, 6)
         args.timeout = min(args.timeout, 300)
     return orchestrate(args)

@@ -514,6 +514,65 @@ _trace_path = igg.dump_trace(os.environ["IGG_TELEMETRY_DIR"])
 assert _trace_path is not None and os.path.isfile(_trace_path), _trace_path
 assert _trace_path.endswith(f"trace.p{pid}.json"), _trace_path
 
+# --- Transport-checksum integrity plane over real gloo hops (ISSUE 18):
+# with IGG_INTEGRITY=1 the coalesced packed exchange carries per-hop
+# XOR-fold checksum words on the same ppermute payload.  Arm an in-flight
+# payload-word flip on block rank 0 (process 0's x=0 corner block): its
+# upper-x send lands on block 4, which lives on PROCESS 1 — so the
+# RECEIVER (this worker's pid 1) must trip with an IntegrityError that
+# implicates the SENDER (rank 0), and its reason=sdc flight bundle must
+# carry that attribution for `supervisor.classify`.  Process 0 sends the
+# lie and must see nothing locally.  The flip is consumed by one exchange:
+# the next checksummed exchange must be clean again (no poisoned cache).
+from implicitglobalgrid_tpu.integrity import IntegrityError
+from implicitglobalgrid_tpu.ops import halo as _halo
+
+os.environ["IGG_INTEGRITY"] = "1"
+try:
+    sI, _pI = diffusion3d.setup(NX, NX, NX, init_grid=False)
+    TI, CpI = sI[0], sI[1]
+    # clean checksummed exchange: zero false positives, and still the
+    # bitwise no-op a consistent field demands
+    oT, oCp = igg.update_halo(TI + 0, CpI + 0)
+    _dmax = jax.jit(lambda a, b: jnp.max(jnp.abs(a - b)))
+    assert float(_dmax(oT, TI)) == 0.0, "checksummed exchange not a no-op"
+    assert float(_dmax(oCp, CpI)) == 0.0
+
+    _halo.arm_transport_flip(0)
+    _trip = None
+    try:
+        igg.update_halo(TI + 0, CpI + 0)
+    except IntegrityError as e:
+        _trip = e
+    if pid == 1:
+        assert _trip is not None, (
+            "receiver did not trip on the flipped transport payload"
+        )
+        assert _trip.implicated_rank == 0, vars(_trip)
+        assert _trip.detector == "transport_checksum", vars(_trip)
+        _fl = os.path.join(
+            os.environ["IGG_TELEMETRY_DIR"], f"flight_{pid}.json"
+        )
+        assert os.path.isfile(_fl), "no sdc flight bundle on the receiver"
+        _sdc = [
+            r for r in map(_json.loads, open(_fl))
+            if r.get("reason") == "sdc"
+        ]
+        assert _sdc and _sdc[-1]["info"].get("implicated_rank") == 0, _sdc
+        assert tele.snapshot()["counters"].get(
+            "integrity.transport_mismatches", 0
+        ) >= 1
+    else:
+        assert _trip is None, (
+            f"sender tripped on its own clean receives: {_trip}"
+        )
+
+    # flip consumed: the clean cached program serves the next exchange
+    oT2, _ = igg.update_halo(TI + 0, CpI + 0)
+    assert float(_dmax(oT2, TI)) == 0.0, "post-flip exchange not clean"
+finally:
+    del os.environ["IGG_INTEGRITY"]
+
 igg.finalize_global_grid()
 assert not igg.grid_is_initialized()
 assert not dist.is_distributed_initialized()  # finalize tore the runtime down
