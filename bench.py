@@ -212,6 +212,88 @@ def _frontdoor_serving_record(n=32, requests=6, max_steps=8, capacity=2):
         igg.finalize_global_grid()
 
 
+def _request_trace_record(n=32, max_steps=6, capacity=2):
+    """ISSUE 19: the request critical-path record — ONE traced request
+    through the real loopback front door (inbound W3C ``traceparent``
+    accepted and echoed), its causal tree reconstructed in-process from
+    the span ring (the same per-rank doc schema ``igg_trace.py`` reads)
+    and its latency attributed per segment (`utils.tracing.critical_path`).
+    The flat ``*_share`` keys are REPORTED perf-gate keys
+    (`analysis.perf.REPORTED_KEYS`): a latency regression names its
+    segment from the artifact alone.
+    """
+    import json as _json
+    import urllib.request
+
+    import implicitglobalgrid_tpu as igg
+    from implicitglobalgrid_tpu.models import diffusion3d
+    from implicitglobalgrid_tpu.serving import FrontDoor, ServingLoop
+    from implicitglobalgrid_tpu.utils import tracing as _tracing
+
+    if not _tracing.enabled():
+        return {"skipped": "tracing disabled (IGG_TELEMETRY/IGG_TRACE_RING)"}
+    if igg.grid_is_initialized():
+        igg.finalize_global_grid()
+    igg.init_global_grid(n, n, n, quiet=True)
+    try:
+        _, params = diffusion3d.setup(n, n, n, init_grid=False)
+        loop = ServingLoop(
+            diffusion3d, params, capacity=capacity, steps_per_round=1
+        )
+        fd = FrontDoor(loop, port=0)
+        try:
+            inbound = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fd.port}/v1/submit",
+                data=_json.dumps({
+                    "tenant": "trace", "params": {"max_steps": max_steps},
+                }).encode(),
+                headers={"traceparent": inbound},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                rid = _json.load(r)["request_id"]
+                echoed = r.headers.get("traceparent")
+            budget = max_steps + 8
+            while budget > 0 and (
+                (fd.result_view(rid) or {}).get("status") != "done"
+            ):
+                fd.serve_rounds(max_rounds=1)
+                budget -= 1
+            view = fd.result_view(rid)
+            if not view or view.get("status") != "done":
+                raise RuntimeError(f"traced request never completed: {view}")
+            ctx = _tracing.parse_traceparent(echoed)
+            if ctx is None or ctx["trace_id"] != "ab" * 16:
+                raise RuntimeError(
+                    f"traceparent did not round-trip: {echoed!r}"
+                )
+            # the in-process twin of dump_trace's per-rank doc — the tree
+            # builds from the live ring without touching disk
+            doc = {
+                "schema": _tracing.TRACE_SCHEMA, "rank": 0, "gen": None,
+                "dropped": _tracing.spans_dropped(),
+                "clock_sync": _tracing.clock_sync(),
+                "spans": _tracing.span_records(),
+            }
+            tree = _tracing.request_tree([doc], ctx["trace_id"])
+            cp = _tracing.critical_path(tree)
+            rec = {
+                "trace_id": ctx["trace_id"],
+                "spans": tree["spans"],
+                "incomplete": tree["incomplete"],
+                "latency_s": round(view["latency_s"], 4),
+                "total_s": round(cp["total_s"], 4),
+            }
+            for seg, v in cp["segments"].items():
+                rec[f"{seg}_share"] = round(v["share"], 4)
+                rec[f"{seg}_s"] = round(v["s"], 6)
+            return rec
+        finally:
+            fd.close()
+    finally:
+        igg.finalize_global_grid()
+
+
 def _batch_extra(rec=None):
     # ISSUE 8: the ensemble-batching record — members/s/chip over a
     # B∈{1,2,4,8} sweep of the vmapped serving cadence.  Every sweep row's
@@ -599,6 +681,9 @@ def main(out: str | None = None):
     # ISSUE 12: the front-door serving record (gated rounds/s + inverse
     # submit→result latencies; see _frontdoor_serving_record).
     _extra("frontdoor_serving", _frontdoor_serving_record)
+    # ISSUE 19: one traced request's critical-path decomposition — the
+    # reported *_share perf-gate keys (see _request_trace_record).
+    _extra("request_trace", _request_trace_record)
 
     def _profile_attribution():
         # ISSUE 15: the measured device-timeline record — a windowed

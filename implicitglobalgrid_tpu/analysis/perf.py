@@ -154,9 +154,17 @@ def gate_metrics(record: dict) -> dict:
 #: `utils.profiling.overlap_measure`): the number ROADMAP item 1's
 #: Pallas-native exchange must push up, on the same reported-first on-ramp
 #: achieved_fraction took (promote to GATED once a chip-env round records
-#: it).
+#: it).  The ``*_share`` keys are the request critical-path decomposition
+#: (``extras.request_trace``, ISSUE 19 — `utils.tracing.critical_path`):
+#: the traced request's latency attributed to queue-wait / admission /
+#: rounds / exchange / checkpoint / re-route and the uncovered remainder —
+#: reported per round so a latency regression names its segment before
+#: anyone opens a trace viewer.
 REPORTED_KEYS = ("achieved_fraction", "submit_to_result_p50_s",
-                 "submit_to_result_p99_s", "overlap_fraction")
+                 "submit_to_result_p99_s", "overlap_fraction",
+                 "queue_wait_share", "admission_share", "rounds_share",
+                 "exchange_share", "checkpoint_share", "reroute_share",
+                 "other_share")
 
 
 def reported_metrics(record: dict) -> dict:

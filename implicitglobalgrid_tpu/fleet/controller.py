@@ -45,6 +45,7 @@ from ..supervisor.classify import Incident
 from ..supervisor import generation as _generation
 from ..utils import config as _config
 from ..utils import telemetry as _telemetry
+from ..utils import tracing as _tracing
 from . import canary as _canary
 from . import policy as _policy
 from .router import FleetRouter, scrape_health
@@ -306,14 +307,25 @@ class FleetController:
         waits on the reboot."""
         spec = self.specs[name]
         handle = self.handles.get(name)
-        self.generations[name] = self.generations.get(name, 0) + 1
-        _generation.publish_generation(
-            self.generations[name], spec.workdir, pool=name, reason=reason
-        )
-        if handle is not None:
-            handle.kill()
-        self.router.unregister_pool(name)
-        self.router.evacuate(name)
+        # The detection→evacuation hop belongs to every stranded request's
+        # causal tree: one span tagged with the victim routes' trace ids
+        # (the reroute span `evacuate` opens nests under it).
+        with self.router._lock:
+            trace_ids = sorted({
+                r["trace"]["trace_id"] for r in self.router.routes.values()
+                if r["pool"] == name and r["done"] is None and r.get("trace")
+            })
+        span_tags = {"trace_ids": trace_ids} if trace_ids else {}
+        with _tracing.trace_span("igg.fleet.detect", pool=name,
+                                 reason=reason, **span_tags):
+            self.generations[name] = self.generations.get(name, 0) + 1
+            _generation.publish_generation(
+                self.generations[name], spec.workdir, pool=name, reason=reason
+            )
+            if handle is not None:
+                handle.kill()
+            self.router.unregister_pool(name)
+            self.router.evacuate(name)
         self.launch_pool(name)
         deadline = time.monotonic() + 60.0
         while (

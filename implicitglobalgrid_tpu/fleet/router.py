@@ -39,6 +39,7 @@ import urllib.request
 
 from ..utils import config as _config
 from ..utils import telemetry as _telemetry
+from ..utils import tracing as _tracing
 
 __all__ = [
     "FleetRouter",
@@ -183,11 +184,14 @@ def _make_handler(router: "FleetRouter"):
         server_version = "igg-fleet/1"
         timeout = 10
 
-        def _reply(self, code: int, body: dict):
+        def _reply(self, code: int, body: dict,
+                   headers: dict | None = None):
             data = json.dumps(body, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -195,8 +199,9 @@ def _make_handler(router: "FleetRouter"):
             path = self.path.split("?", 1)[0]
             try:
                 if path.startswith("/v1/result/"):
-                    code, body = router.result(path[len("/v1/result/"):])
-                    self._reply(code, body)
+                    fid = path[len("/v1/result/"):]
+                    code, body = router.result(fid)
+                    self._reply(code, body, headers=router.trace_header(fid))
                 elif path == "/v1/status":
                     self._reply(200, router.status_view())
                 elif path == "/healthz":
@@ -228,8 +233,14 @@ def _make_handler(router: "FleetRouter"):
                     except (ValueError, UnicodeDecodeError) as e:
                         self._reply(400, {"error": f"bad JSON body: {e}"})
                         return
-                    code, out = router.submit(doc)
-                    self._reply(code, out)
+                    tp = self.headers.get("traceparent")
+                    code, out = router.submit(doc, traceparent=tp)
+                    hdrs = router.trace_header(out.get("request_id"))
+                    if hdrs is None and tp:
+                        # untraced (sampled-out / error) replies still echo
+                        # the caller's context verbatim — pure passthrough
+                        hdrs = {"traceparent": tp}
+                    self._reply(code, out, headers=hdrs)
                 else:
                     self.send_error(404, "unknown endpoint")
             except Exception as e:
@@ -342,11 +353,36 @@ class FleetRouter:
 
     # - the routed surface -
 
-    def submit(self, doc: dict) -> tuple[int, dict]:
+    def submit(self, doc: dict,
+               *, traceparent: str | None = None) -> tuple[int, dict]:
         """Route one submit: choose a pool, forward, record the sticky
         route.  A pool that drops the forward (transport (0, _)) is
         marked unreachable for this pass and the next-best pool tried —
-        a wedged pool costs one timeout, never a failed request."""
+        a wedged pool costs one timeout, never a failed request.
+
+        Trace context: an inbound ``doc["trace"]`` (a replayed spec) or a
+        W3C ``traceparent`` header is adopted; otherwise one is minted
+        here, head-sampled (`tracing.should_sample`).  The routing hop
+        records an ``igg.fleet.route`` span and the doc forwarded to the
+        pool carries that span's context as ``doc["trace"]`` — the pool's
+        front door chains under it, and a later `evacuate` re-submits the
+        same spec, so re-routes inherit the request's identity for free."""
+        inbound = doc.get("trace") if isinstance(doc.get("trace"), dict) \
+            else None
+        if inbound is None:
+            inbound = _tracing.parse_traceparent(traceparent)
+        ctx = None
+        t0 = 0.0
+        if _tracing.enabled() and (
+            inbound is not None or _tracing.should_sample()
+        ):
+            tid = inbound["trace_id"] if inbound else _tracing.new_trace_id()
+            ctx = {"trace_id": tid, "span_id": _tracing.new_span_id()}
+            if inbound and inbound.get("span_id"):
+                ctx["parent_id"] = inbound["span_id"]
+            doc = dict(doc)
+            doc["trace"] = {"trace_id": tid, "span_id": ctx["span_id"]}
+            t0 = time.perf_counter()
         tried: set[str] = set()
         while True:
             cands = [
@@ -376,12 +412,38 @@ class FleetRouter:
                 self.routes[fid] = {
                     "pool": name, "rid": body["request_id"],
                     "spec": dict(doc), "epoch": 0, "done": None,
+                    "trace": ctx,
                 }
             _telemetry.counter("fleet.routed_total").inc()
+            trace_tags = {"trace_id": ctx["trace_id"]} if ctx else {}
             _telemetry.event("fleet.route", request=fid, pool=name,
                              rid=body["request_id"],
-                             tenant=doc.get("tenant", "default"))
+                             tenant=doc.get("tenant", "default"),
+                             **trace_tags)
+            if ctx is not None:
+                _tracing.record_span(
+                    "igg.fleet.route",
+                    t0=t0, dur=time.perf_counter() - t0,
+                    parent={"trace_id": ctx["trace_id"],
+                            "span_id": ctx.get("parent_id")},
+                    span_id=ctx["span_id"],
+                    request=fid, pool=name,
+                    tenant=doc.get("tenant", "default"),
+                )
             return 202, {"request_id": fid, "pool": name}
+
+    def trace_header(self, fid: str | None) -> dict | None:
+        """The ``traceparent`` echo header for a routed request (None when
+        the route is unknown or untraced) — every response that names a
+        fleet id carries the request's context back to the caller."""
+        if not fid:
+            return None
+        with self._lock:
+            route = self.routes.get(fid)
+            ctx = route.get("trace") if route else None
+        if not ctx:
+            return None
+        return {"traceparent": _tracing.format_traceparent(ctx)}
 
     def adopt_result(self, fid: str, pool: str, epoch: int,
                      body: dict) -> bool:
@@ -462,29 +524,39 @@ class FleetRouter:
             ]
             for _fid, route in victims:
                 route["epoch"] += 1  # late answers are zombies from here on
+        # The re-route hop is part of every evacuated request's causal
+        # tree: one span tagged with ALL victims' trace ids (the
+        # multi-request form, like a serving round).
+        trace_ids = sorted({
+            route["trace"]["trace_id"] for _fid, route in victims
+            if route.get("trace")
+        })
+        span_tags = {"trace_ids": trace_ids} if trace_ids else {}
         moved: list[str] = []
-        for fid, route in victims:
-            tried = set(base_exclude)
-            while True:
-                cands = [
-                    dict(c, health=c["health"] or pool_health_view(None))
-                    for c in self._candidates() if c["name"] not in tried
-                ]
-                target = choose_pool(route["spec"], cands)
-                if target is None:
-                    break  # unroutable now; the next evacuate retries
-                code, body = self.transport(
-                    self.pools[target]["endpoint"], "POST", "/v1/submit",
-                    route["spec"],
-                )
-                if code != 202:
-                    tried.add(target)
-                    continue
-                with self._lock:
-                    route["pool"] = target
-                    route["rid"] = body["request_id"]
-                moved.append(fid)
-                break
+        with _tracing.trace_span("igg.fleet.reroute", pool=name,
+                                 victims=len(victims), **span_tags):
+            for fid, route in victims:
+                tried = set(base_exclude)
+                while True:
+                    cands = [
+                        dict(c, health=c["health"] or pool_health_view(None))
+                        for c in self._candidates() if c["name"] not in tried
+                    ]
+                    target = choose_pool(route["spec"], cands)
+                    if target is None:
+                        break  # unroutable now; the next evacuate retries
+                    code, body = self.transport(
+                        self.pools[target]["endpoint"], "POST", "/v1/submit",
+                        route["spec"],
+                    )
+                    if code != 202:
+                        tried.add(target)
+                        continue
+                    with self._lock:
+                        route["pool"] = target
+                        route["rid"] = body["request_id"]
+                    moved.append(fid)
+                    break
         _telemetry.counter("fleet.rerouted_total").inc(len(moved))
         _telemetry.event("fleet.reroute", pool=name, requests=moved,
                          count=len(moved))

@@ -42,6 +42,7 @@ import time
 from ..utils import config as _config
 from ..utils import liveplane as _liveplane
 from ..utils import telemetry as _telemetry
+from ..utils import tracing as _tracing
 
 #: reject reasons, in evaluation order (docs/serving.md)
 REASONS = ("slo", "backpressure", "quota")
@@ -281,35 +282,40 @@ class AdmissionController:
               view: dict | None = None) -> Decision:
         """Decide one request NOW: build the live view (or take the
         caller's), refill the tenant's bucket, run `decide`, consume a
-        token only on admission, and account the outcome."""
-        if now is None:
-            now = self._clock()
-        bucket = self._bucket(tenant)
-        if view is None:
-            view = self._live_view(now)
-        wait = None
-        if bucket is not None:
-            # refill → decide → take under ONE lock acquisition: two
-            # concurrent submits must not both observe the same token and
-            # both admit (check-then-act) — `decide` is pure and cheap, so
-            # holding the lock across it is fine
-            with self._lock:
-                view = dict(view, tenant_tokens=bucket.refill(now))
+        token only on admission, and account the outcome.  The decision
+        runs under an ``igg.serving.admission`` span — inside a request
+        context (the front door's submit path) it chains into the
+        request's causal tree and the critical-path analyzer charges its
+        time to the ``admission`` segment."""
+        with _tracing.trace_span("igg.serving.admission", tenant=tenant):
+            if now is None:
+                now = self._clock()
+            bucket = self._bucket(tenant)
+            if view is None:
+                view = self._live_view(now)
+            wait = None
+            if bucket is not None:
+                # refill → decide → take under ONE lock acquisition: two
+                # concurrent submits must not both observe the same token
+                # and both admit (check-then-act) — `decide` is pure and
+                # cheap, so holding the lock across it is fine
+                with self._lock:
+                    view = dict(view, tenant_tokens=bucket.refill(now))
+                    verdict = decide(view, self.policy)
+                    if verdict["admit"]:
+                        bucket.take()
+                    elif verdict["reason"] == "quota":
+                        wait = bucket.seconds_until_token()
+            else:
                 verdict = decide(view, self.policy)
-                if verdict["admit"]:
-                    bucket.take()
-                elif verdict["reason"] == "quota":
-                    wait = bucket.seconds_until_token()
-        else:
-            verdict = decide(view, self.policy)
-        retry = 0.0 if verdict["admit"] else retry_after_s(
-            view, self.policy, verdict["reason"], bucket_wait_s=wait
-        )
-        self._account(tenant, verdict)
-        return Decision(
-            admit=verdict["admit"], reason=verdict["reason"],
-            retry_after_s=retry, view=view,
-        )
+            retry = 0.0 if verdict["admit"] else retry_after_s(
+                view, self.policy, verdict["reason"], bucket_wait_s=wait
+            )
+            self._account(tenant, verdict)
+            return Decision(
+                admit=verdict["admit"], reason=verdict["reason"],
+                retry_after_s=retry, view=view,
+            )
 
     def _account(self, tenant: str, verdict: dict) -> None:
         if verdict["admit"]:

@@ -121,6 +121,18 @@ def endpoint_filename(rank: int) -> str:
     return f"frontdoor.p{rank}.json"
 
 
+def _member_ctx(rec_trace: dict | None) -> dict | None:
+    """The member-level trace context from a ledgered request's trace
+    record (the shape checkpoints persist) — what a rebuilt `Request`
+    carries so a restored member's rounds keep tagging its trace."""
+    if rec_trace and rec_trace.get("member_span_id"):
+        return {
+            "trace_id": rec_trace["trace_id"],
+            "span_id": rec_trace["member_span_id"],
+        }
+    return None
+
+
 def state_digest(state) -> dict | None:
     """Per-field sha256 of the de-duplicated GLOBAL state.
 
@@ -249,6 +261,7 @@ def _make_handler(fd: "FrontDoor"):
                 if path.startswith("/v1/result/"):
                     rid = path[len("/v1/result/"):]
                     doc = fd.result_view(rid)
+                    hdrs = fd.trace_header(rid)
                     if doc is None:
                         self._reply(404, {"error": f"unknown request {rid!r}"})
                     elif doc.get("status") == "expired":
@@ -263,7 +276,7 @@ def _make_handler(fd: "FrontDoor"):
                                       "IGG_RESULT_TTL_S retention",
                         })
                     else:
-                        self._reply(200, doc)
+                        self._reply(200, doc, headers=hdrs)
                 elif path == "/v1/status":
                     self._reply(200, fd.status_view())
                 elif path == "/metrics":
@@ -356,7 +369,9 @@ def _make_handler(fd: "FrontDoor"):
                     except (ValueError, UnicodeDecodeError) as e:
                         self._reply(400, {"error": f"bad JSON body: {e}"})
                         return
-                    self._reply(*fd.handle_submit(doc))
+                    self._reply(*fd.handle_submit(
+                        doc, traceparent=self.headers.get("traceparent")
+                    ))
                 elif path == "/v1/shutdown":
                     fd.request_shutdown()
                     self._reply(200, {"ok": True})
@@ -533,17 +548,46 @@ class FrontDoor:
             return f"params.ic_scale must be a number (got {ic!r})"
         return None
 
-    def handle_submit(self, doc: dict):
+    def handle_submit(self, doc: dict, *, traceparent: str | None = None):
         """One ``POST /v1/submit`` → ``(code, body, headers)``.  Validation
         → 400 before admission ever runs; admission → 429 with
         ``Retry-After``; accepted specs land in the pending queue the next
-        control sync broadcasts."""
+        control sync broadcasts.
+
+        Trace context: an inbound ``doc["trace"]`` (a router-forwarded or
+        replayed spec — wins) or W3C ``traceparent`` header is adopted;
+        otherwise one is minted, head-sampled (``IGG_TRACE_SAMPLE``).  A
+        traced request's every response carries ``traceparent`` back; the
+        accepted spec carries a member-level child context into the
+        control broadcast, so every rank's serving rounds tag the
+        request.  Untraced requests pay nothing beyond echoing an inbound
+        header verbatim."""
         tenant = str(doc.get("tenant") or "default")
         _telemetry.counter("frontdoor.requests_total").inc()
+        inbound = doc.get("trace") if isinstance(doc.get("trace"), dict) \
+            else None
+        if inbound is None:
+            inbound = _tracing.parse_traceparent(traceparent)
+        ctx = None
+        t0 = 0.0
+        if _tracing.enabled() and (
+            inbound is not None or _tracing.should_sample()
+        ):
+            tid = inbound["trace_id"] if inbound else _tracing.new_trace_id()
+            ctx = {"trace_id": tid, "span_id": _tracing.new_span_id()}
+            if inbound and inbound.get("span_id"):
+                ctx["parent_id"] = inbound["span_id"]
+            t0 = time.perf_counter()
+        if ctx is not None:
+            echo = {"traceparent": _tracing.format_traceparent(ctx)}
+        elif traceparent:
+            echo = {"traceparent": str(traceparent)}  # pure passthrough
+        else:
+            echo = {}
         err = self._validate(doc)
         if err is not None:
             _telemetry.counter("frontdoor.invalid_total").inc()
-            return 400, {"error": err}, {}
+            return 400, {"error": err}, echo
         # Decision + append run under the SAME lock `_directives` holds
         # when it flips `_refusing` and drains pending: every request is
         # accounted exactly once (admitted XOR rejected), and every 202
@@ -552,8 +596,10 @@ class FrontDoor:
         # door lock across it costs microseconds, not a snapshot.
         with self._lock:
             if self._refusing:
-                return self._reject_resizing(tenant)
-            decision = self.admission.check(tenant)
+                code, body, hdrs = self._reject_resizing(tenant)
+                return code, body, {**hdrs, **echo}
+            with _tracing.use_context(ctx):
+                decision = self.admission.check(tenant)
             if not decision.admit:
                 _telemetry.event(
                     "frontdoor.reject", tenant=tenant, reason=decision.reason,
@@ -566,7 +612,8 @@ class FrontDoor:
                         "reason": decision.reason,
                         "retry_after_s": round(decision.retry_after_s, 3),
                     },
-                    {"Retry-After": str(max(1, int(-(-decision.retry_after_s // 1))))},
+                    {"Retry-After": str(max(1, int(-(-decision.retry_after_s // 1)))),
+                     **echo},
                 )
             params = doc.get("params", {})
             spec = {
@@ -580,15 +627,54 @@ class FrontDoor:
             rid = f"r{self._next_request:06d}"
             self._next_request += 1
             spec["id"] = rid
+            rec_trace = None
+            if ctx is not None:
+                # The member-level child context: rides the spec through
+                # the control broadcast (every rank tags its rounds with
+                # it), the checkpoint slot metadata (a trace survives a
+                # generation bump) and any re-routed replay of the spec.
+                member_ctx = {
+                    "trace_id": ctx["trace_id"],
+                    "span_id": _tracing.new_span_id(),
+                }
+                spec["trace"] = member_ctx
+                rec_trace = {**ctx, "member_span_id": member_ctx["span_id"]}
             self._requests[rid] = {
                 "id": rid, "tenant": tenant, "params": spec["params"],
                 "submitted_ts": time.time(), "member": None, "done": None,
+                "trace": rec_trace,
             }
             self._pending.append(spec)
             _telemetry.gauge("frontdoor.pending").set(len(self._pending))
+        self._publish_oldest_gauge()
+        trace_tags = {"trace_id": ctx["trace_id"]} if ctx else {}
         _telemetry.event("frontdoor.admit", request=rid, tenant=tenant,
-                         **spec["params"])
-        return 202, {"request_id": rid}, {}
+                         **spec["params"], **trace_tags)
+        if ctx is not None:
+            # The HTTP-handler hop (validation + admission + enqueue),
+            # chained under the request span recorded at harvest.
+            _tracing.record_span(
+                "igg.frontdoor.submit",
+                t0=t0, dur=time.perf_counter() - t0,
+                parent={"trace_id": ctx["trace_id"],
+                        "span_id": ctx["span_id"]},
+                request=rid, tenant=tenant,
+            )
+        return 202, {"request_id": rid}, echo
+
+    def trace_header(self, rid: str) -> dict | None:
+        """The ``traceparent`` echo header for a ledgered request (None
+        when unknown or untraced)."""
+        with self._lock:
+            rec = self._requests.get(rid)
+            tr = rec.get("trace") if rec else None
+        if not tr:
+            return None
+        return {
+            "traceparent": _tracing.format_traceparent(
+                {"trace_id": tr["trace_id"], "span_id": tr["span_id"]}
+            )
+        }
 
     def _reject_resizing(self, tenant: str):
         """Mid-resize 429: the pool is checkpointing for a restart — turn
@@ -773,19 +859,39 @@ class FrontDoor:
 
     def _admit_spec(self, spec: dict) -> None:
         params = spec["params"]
+        trace = spec.get("trace") if isinstance(spec.get("trace"), dict) \
+            else None
         state = self._build_state(params.get("ic_scale", 1.0))
         request = Request(
             state=state,
             max_steps=int(params["max_steps"]),
             tenant=spec.get("tenant", "default"),
             tol=params.get("tol"),
+            trace=trace,
         )
         member = self.loop.submit(request)
         if self.rank == 0:
+            rec = None
             with self._lock:
                 rec = self._requests.get(spec.get("id"))
                 if rec is not None:
                     rec["member"] = member
+            rtr = rec.get("trace") if rec else None
+            if trace is not None and rtr is not None:
+                # Queue wait, retroactively: submit→admission-into-a-slot,
+                # recorded under the PRE-BROADCAST member span id so every
+                # rank's round spans (which carry the same member context)
+                # parent here without any cross-process id exchange.
+                wait = time.time() - rec["submitted_ts"]
+                _tracing.record_span(
+                    "igg.frontdoor.admit",
+                    t0=time.perf_counter() - wait, dur=wait,
+                    parent={"trace_id": rtr["trace_id"],
+                            "span_id": rtr.get("span_id")},
+                    span_id=trace.get("span_id"),
+                    request=spec.get("id"), member=member,
+                    tenant=spec.get("tenant", "default"),
+                )
 
     def _harvest(self) -> None:
         """Collect newly retired members: the collective digest, the
@@ -827,17 +933,51 @@ class FrontDoor:
             _telemetry.counter("frontdoor.completed_total").inc()
             _telemetry.histogram("frontdoor.request_seconds").record(latency)
             _telemetry.tenant_histogram(rec["tenant"]).record(latency)
+            tr = rec.get("trace")
+            trace_tags = {"trace_id": tr["trace_id"]} if tr else {}
             _telemetry.event(
                 "frontdoor.complete", request=rec["id"], member=member,
                 tenant=rec["tenant"], result=res.status, steps=res.steps,
-                latency_s=round(latency, 6),
+                latency_s=round(latency, 6), **trace_tags,
             )
+            if tr is not None:
+                # The request's root-side span: submit→result on the door,
+                # recorded retroactively under the ledgered S_req id so the
+                # whole tree (submit hop, queue wait, rounds on every rank,
+                # re-routes) hangs off one span.
+                _tracing.record_span(
+                    "igg.frontdoor.request",
+                    t0=time.perf_counter() - latency, dur=latency,
+                    parent={"trace_id": tr["trace_id"],
+                            "span_id": tr.get("parent_id")},
+                    span_id=tr["span_id"],
+                    request=rec["id"], member=member, tenant=rec["tenant"],
+                    result=res.status,
+                )
         # The loop prunes consumed member states at round end; mirror the
         # bound here so a request flood cannot grow the door either —
         # member ids never repeat, so the intersection is monotone-safe.
         self._seen_results &= set(self.loop.results)
         if self.rank == 0:
             self._prune_requests()
+            self._publish_oldest_gauge()
+
+    def _publish_oldest_gauge(self) -> None:
+        """Rank 0: publish the oldest in-flight submit timestamp as the
+        ``frontdoor.oldest_submitted_ts`` gauge (0 = nothing in flight).
+        ``/healthz`` and ``igg_top`` turn it into the worst in-flight
+        request AGE at scrape time — publishing the timestamp rather than
+        a precomputed age keeps the reading fresh between publishes."""
+        if self.rank != 0:
+            return
+        with self._lock:
+            inflight = [
+                r["submitted_ts"] for r in self._requests.values()
+                if r["done"] is None
+            ]
+        _telemetry.gauge("frontdoor.oldest_submitted_ts").set(
+            min(inflight) if inflight else 0
+        )
 
     def _prune_requests(self) -> None:
         """Expire DONE ledger records under the retention knobs (rank 0).
@@ -933,6 +1073,7 @@ class FrontDoor:
                         "tenant": r["tenant"], "params": r["params"],
                         "submitted_ts": r["submitted_ts"],
                         "member": r["member"], "done": r["done"],
+                        "trace": r.get("trace"),
                     }
                     for rid, r in self._requests.items()
                 },
@@ -1070,6 +1211,7 @@ class FrontDoor:
                     max_steps=int(params["max_steps"]),
                     tenant=rec.get("tenant", "default"),
                     tol=params.get("tol"),
+                    trace=_member_ctx(rec.get("trace")),
                 ),
             )
         self.loop._next_member = max(
@@ -1092,6 +1234,7 @@ class FrontDoor:
                 max_steps=int(params["max_steps"]),
                 tenant=rec.get("tenant", "default"),
                 tol=params.get("tol"),
+                trace=_member_ctx(rec.get("trace")),
             ))
             rec["member"] = member
         if self.rank == 0:
@@ -1111,6 +1254,7 @@ class FrontDoor:
                         "submitted_ts": rec.get("submitted_ts", time.time()),
                         "member": rec.get("member"),
                         "done": rec.get("done"),
+                        "trace": rec.get("trace"),
                     }
             # members that already retired stay harvested; the restored
             # ledger answers /v1/result for them without their states
@@ -1118,6 +1262,7 @@ class FrontDoor:
             int(rec["member"]) for rec in requests.values()
             if rec.get("done") is not None and rec.get("member") is not None
         )
+        self._publish_oldest_gauge()
         _telemetry.counter("frontdoor.resumes_total").inc()
         _telemetry.event(
             "frontdoor.resume", checkpoint=latest, mode="elastic",

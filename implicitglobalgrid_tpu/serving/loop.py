@@ -108,12 +108,18 @@ class Request:
     max_steps`` — up to ``steps_per_round - 1`` steps past the budget
     (``MemberResult.steps`` reports the actual count).  Pick a
     ``steps_per_round`` that divides your budgets for exact step counts.
+
+    ``trace`` (optional): the request's member-level trace context
+    (``{"trace_id", "span_id"}`` — `utils.tracing`); it rides the slot,
+    the round spans' member tags and the checkpoint slot metadata, so a
+    traced request stays reconstructable across rounds and restarts.
     """
 
     state: tuple
     max_steps: int
     tenant: str = "default"
     tol: float | None = None
+    trace: dict | None = None
 
 
 @dataclasses.dataclass
@@ -144,6 +150,7 @@ class _Slot:
     snapshot: tuple | None = None
     snapshot_steps: int = 0
     rollbacks: int = 0
+    trace: dict | None = None
 
 
 class ServingLoop:
@@ -393,6 +400,7 @@ class ServingLoop:
                 member=int(rec["member"]), tenant=rec.get("tenant", ""),
                 max_steps=int(rec["max_steps"]), tol=rec.get("tol"),
                 steps=int(rec.get("steps", 0)), active=True,
+                trace=rec.get("trace"),
             )
             if self.guard_policy == "rollback":
                 self.slots[k].snapshot = _batched.member_state(self._state, k)
@@ -428,6 +436,7 @@ class ServingLoop:
             self.slots[k] = _Slot(
                 member=member, tenant=req.tenant,
                 max_steps=int(req.max_steps), tol=tol, active=True,
+                trace=req.trace,
             )
             if self.guard_policy == "rollback":
                 self.slots[k].snapshot = _batched.member_state(self._state, k)
@@ -545,11 +554,24 @@ class ServingLoop:
         self._admit_from_queue()
         mask = self._mask()
         members = [
-            {"member": s.member, "slot": k, "tenant": s.tenant}
+            {
+                "member": s.member, "slot": k, "tenant": s.tenant,
+                **({"trace": s.trace} if s.trace else {}),
+            }
             for k, s in enumerate(self.slots)
             if s.active
         ]
-        with _tracing.trace_span(
+        # The round advances MANY requests at once: the span carries every
+        # active request's context (the multi-request form), so anything
+        # nested under the round — checkpoint saves, audits, host-side
+        # exchanges — inherits the same trace_ids ambiently.
+        round_ctx = None
+        trace_ids = sorted({
+            m["trace"]["trace_id"] for m in members if "trace" in m
+        })
+        if trace_ids:
+            round_ctx = {"trace_ids": trace_ids}
+        with _tracing.use_context(round_ctx), _tracing.trace_span(
             "igg.serving.round", round=self.rounds, members=members,
             queued=len(self.queue),
         ):
@@ -816,6 +838,7 @@ class ServingLoop:
                         "member": s.member, "tenant": s.tenant,
                         "max_steps": s.max_steps, "tol": s.tol,
                         "steps": s.steps, "active": s.active,
+                        "trace": s.trace,
                     }
                     for s in self.slots
                 ],
@@ -878,6 +901,7 @@ class ServingLoop:
                 member=int(rec["member"]), tenant=rec["tenant"],
                 max_steps=int(rec["max_steps"]), tol=rec["tol"],
                 steps=int(rec["steps"]), active=bool(rec["active"]),
+                trace=rec.get("trace"),
             )
             if self.guard_policy == "rollback" and self.slots[k].active:
                 self.slots[k].snapshot = _batched.member_state(self._state, k)

@@ -41,6 +41,7 @@ from typing import Callable, Sequence
 
 from ..utils import config as _config
 from ..utils import telemetry as _telemetry
+from ..utils import tracing as _tracing
 # NOTE: the package __init__ re-exports the `classify` FUNCTION under the
 # same name as its module, so names must be imported from the module by
 # its dotted path, never via a package attribute.
@@ -216,33 +217,38 @@ class RunSupervisor:
     def launch(self) -> Incarnation:
         """Spawn one incarnation at the current rung/generation (fence
         published first: the authoritative token always leads the procs
-        that carry it)."""
+        that carry it).  Spawning runs under an ``igg.supervisor.launch``
+        span: a request context active across a restart (the serving
+        resize path) ties the relaunch into the affected requests'
+        causal trees."""
         gen, rung = self.state.generation, self.state.rung
         nranks = self.ladder[rung]
-        _generation.publish_generation(
-            gen, self.workdir, rung=rung, nranks=nranks
-        )
-        os.makedirs(self.workdir, exist_ok=True)
-        env = self._child_env()
-        procs, logs = [], []
-        t0 = time.time()
-        for rank in range(nranks):
-            log_path = os.path.join(
-                self.workdir, f"{self.name}_g{gen}_r{rank}.log"
+        with _tracing.trace_span("igg.supervisor.launch", generation=gen,
+                                 rung=rung, nranks=nranks):
+            _generation.publish_generation(
+                gen, self.workdir, rung=rung, nranks=nranks
             )
-            logs.append(log_path)
-            f = open(log_path, "w")
-            try:
-                procs.append(subprocess.Popen(
-                    list(self.command_for(rank, nranks, rung, gen)),
-                    env=env, stdout=f, stderr=subprocess.STDOUT, text=True,
-                ))
-            finally:
-                f.close()  # the child holds its own descriptor
-        inc = Incarnation(
-            generation=gen, rung=rung, nranks=nranks, procs=procs,
-            log_paths=logs, t0=t0,
-        )
+            os.makedirs(self.workdir, exist_ok=True)
+            env = self._child_env()
+            procs, logs = [], []
+            t0 = time.time()
+            for rank in range(nranks):
+                log_path = os.path.join(
+                    self.workdir, f"{self.name}_g{gen}_r{rank}.log"
+                )
+                logs.append(log_path)
+                f = open(log_path, "w")
+                try:
+                    procs.append(subprocess.Popen(
+                        list(self.command_for(rank, nranks, rung, gen)),
+                        env=env, stdout=f, stderr=subprocess.STDOUT, text=True,
+                    ))
+                finally:
+                    f.close()  # the child holds its own descriptor
+            inc = Incarnation(
+                generation=gen, rung=rung, nranks=nranks, procs=procs,
+                log_paths=logs, t0=t0,
+            )
         self._event(
             "supervisor.launch", generation=gen, rung=rung, nranks=nranks,
             faults=list(self._fault_specs),

@@ -139,6 +139,12 @@ The *mechanism* carries over with the TPU-meaningful knobs:
                           (`utils.tracing`; int >= 0, default 4096; 0
                           disables span recording entirely) — read per
                           span, like ``IGG_TELEMETRY``
+``IGG_TRACE_SAMPLE``      head-based request-trace sampling rate at the
+                          serving/fleet front doors (number in [0, 1],
+                          default 1.0 = every request gets a trace
+                          context minted); 0 disables minting entirely —
+                          no context allocation, no header emission
+                          beyond echoing an inbound ``traceparent``
 ``IGG_SKEW_WARN``         straggler threshold for the all-ranks skew probe
                           (number >= 0, default 2.0): a ``skew.straggler``
                           event fires when max/min per-rank step wall time
@@ -569,6 +575,13 @@ def trace_ring_env() -> int | None:
     """``IGG_TRACE_RING``: per-process span ring-buffer capacity (>= 0;
     0 disables span recording; unset = the `utils.tracing` default)."""
     return _int_env("IGG_TRACE_RING", minimum=0)
+
+
+def trace_sample_env() -> float | None:
+    """``IGG_TRACE_SAMPLE``: head-based request-trace sampling rate at the
+    front doors (in [0, 1]; 0 mints no contexts, unset/1 traces every
+    request; inbound contexts are never re-sampled)."""
+    return _float_env("IGG_TRACE_SAMPLE", minimum=0)
 
 
 def skew_warn_env() -> float | None:

@@ -23,7 +23,8 @@ watching ``tic``/``toc`` lines scroll by.
     verdict, the rolling ``slo`` quantiles and a bounded ``alerts``
     section (`health_snapshot`);
   - ``/spans`` — the `utils.tracing` ring (plus currently-open spans) as
-    JSON.
+    JSON; ``?name=<substring>`` and ``?request=<trace_id>`` narrow the
+    view to one span family or one request's causal slice.
 
   With ``IGG_TELEMETRY=0`` the server never starts — the PR-4
   no-op-singleton contract extends to the whole plane.
@@ -60,6 +61,7 @@ import os
 import socket
 import threading
 import time
+import urllib.parse as _urlparse
 from typing import Any, Callable
 
 from . import config as _config
@@ -198,6 +200,14 @@ def _health_tail(snap: dict, eng: "RuleEngine", active: list[dict]) -> dict:
             "queue_depth": gauges.get("serving.queue_depth"),
             "capacity": gauges.get("serving.capacity"),
         }
+        # Worst in-flight request age, computed at scrape time from the
+        # front door's oldest-submit gauge (a precomputed age would go
+        # stale between scrapes; a timestamp cannot).
+        oldest = gauges.get("frontdoor.oldest_submitted_ts")
+        if oldest:
+            doc["serving"]["oldest_request_age_s"] = round(
+                max(0.0, time.time() - oldest), 3
+            )
     if "frontdoor.port" in gauges or "frontdoor.requests_total" in counters:
         # The network-facing plane (serving.frontdoor, docs/serving.md):
         # admission totals + per-reason rejects + per-tenant counters, so
@@ -727,6 +737,30 @@ def endpoint_filename(rank: int) -> str:
     return f"liveplane.p{rank}.json"
 
 
+def _span_filter(spans: list[dict], params: dict) -> list[dict]:
+    """Apply ``/spans`` query filters: ``name`` is a substring match on the
+    span name; ``request`` matches a request's trace_id (single-request
+    spans), a multi-request round's ``trace_ids`` entry, or the ``request``
+    tag (the front-door request id)."""
+    names = params.get("name")
+    if names:
+        spans = [s for s in spans if names[0] in s.get("name", "")]
+    reqs = params.get("request")
+    if reqs:
+        rid = reqs[0]
+
+        def _matches(s: dict) -> bool:
+            args = s.get("args") or {}
+            return (
+                args.get("trace_id") == rid
+                or rid in (args.get("trace_ids") or ())
+                or args.get("request") == rid
+            )
+
+        spans = [s for s in spans if _matches(s)]
+    return spans
+
+
 class _Handler(http.server.BaseHTTPRequestHandler):
     server_version = "igg-liveplane/1"
     #: per-connection socket timeout: a stalled scraper drops its
@@ -735,7 +769,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     timeout = 10
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
             if path == "/metrics":
                 # Byte-identical to dump_metrics' .prom output for the same
@@ -753,10 +787,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 ).encode()
                 ctype = "application/json"
             elif path == "/spans":
+                # ?name=<substring> narrows by span name; ?request=<id>
+                # narrows to one request's spans (trace_id, a multi-request
+                # round's trace_ids entry, or the request tag) — the live
+                # complement of `igg_trace.py request` for a still-running
+                # rank (docs/observability.md, request-tracing tier).
+                params = _urlparse.parse_qs(query)
                 doc = {
                     "rank": _telemetry._proc_index(),
-                    "spans": _tracing.span_records(),
-                    "open": _tracing.open_spans(),
+                    "spans": _span_filter(_tracing.span_records(), params),
+                    "open": _span_filter(_tracing.open_spans(), params),
                 }
                 body = json.dumps(doc, default=str).encode()
                 ctype = "application/json"

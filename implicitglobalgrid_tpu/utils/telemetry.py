@@ -617,6 +617,17 @@ def event(etype: str, **payload: Any) -> None:
     gen = _config.generation_env()
     if gen is not None:
         rec["gen"] = gen
+    # An event emitted inside a request-scoped span inherits that request's
+    # trace_id (lazy import: tracing imports telemetry at module scope, so
+    # this edge must stay function-local), letting `igg_trace.py request`
+    # line events up against a request's causal tree.  Absent outside any
+    # request context or when the payload already names one.
+    if "trace_id" not in payload:
+        from . import tracing as _tracing
+
+        ctx = _tracing.current_context()
+        if ctx is not None and "trace_id" in ctx:
+            rec["trace_id"] = ctx["trace_id"]
     rec.update(payload)
     try:
         line = json.dumps(rec, default=str) + "\n"

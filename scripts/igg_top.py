@@ -234,6 +234,7 @@ def summary_rows(healths: dict[int, dict]) -> list[dict]:
                 "skew": h.get("skew", {}).get("step_seconds_max_over_min"),
                 "queue": serving.get("queue_depth"),
                 "members": occupancy,
+                "oldest_s": serving.get("oldest_request_age_s"),
                 "rnd_p50_ms": (rnd.get("p50") or 0) * 1e3 if rnd else None,
                 "rnd_p99_ms": (rnd.get("p99") or 0) * 1e3 if rnd else None,
                 "reject": _reject_rate(frontdoor),
@@ -249,7 +250,7 @@ def render_table(rows: list[dict]) -> str:
     head = (
         f"{'rank':>4} {'ok':>4} {'step':>8} {'age':>8} {'p50':>9} "
         f"{'p99':>9} {'T_eff':>9} {'skew':>6} {'queue':>6} {'mem':>7} "
-        f"{'rnd50':>8} {'rnd99':>8} {'rej':>10}  alerts"
+        f"{'oldest':>8} {'rnd50':>8} {'rnd99':>8} {'rej':>10}  alerts"
     )
     lines = [head, "-" * len(head)]
     for r in rows:
@@ -259,7 +260,7 @@ def render_table(rows: list[dict]) -> str:
             lines.append(
                 f"{r['rank']:>4} {'DOWN':>4} "
                 + " ".join(["-".rjust(w) for w in (8, 8, 9, 9, 9, 6, 6,
-                                                   7, 8, 8, 10)])
+                                                   7, 8, 8, 8, 10)])
                 + f"  {UNREACHABLE} {r['alerts']}"
             )
             continue
@@ -273,6 +274,7 @@ def render_table(rows: list[dict]) -> str:
             f"{_fmt(r['skew'], nd=2):>6} "
             f"{_fmt(r.get('queue'), nd=0):>6} "
             f"{r.get('members') or '-':>7} "
+            f"{_fmt(r.get('oldest_s'), suffix='s'):>8} "
             f"{_fmt(r.get('rnd_p50_ms'), suffix='ms'):>8} "
             f"{_fmt(r.get('rnd_p99_ms'), suffix='ms'):>8} "
             f"{r.get('reject') or '-':>10}  {r['alerts']}"
@@ -311,8 +313,9 @@ def one_view(args, endpoints: list[str]) -> int:
         rows.append({
             "rank": "?", "ok": UNREACHABLE, "coords": None, "step": None,
             "age_s": None, "p50_ms": None, "p99_ms": None, "teff_gbs": None,
-            "skew": None, "queue": None, "members": None, "rnd_p50_ms": None,
-            "rnd_p99_ms": None, "reject": None, "alerts": msg,
+            "skew": None, "queue": None, "members": None, "oldest_s": None,
+            "rnd_p50_ms": None, "rnd_p99_ms": None, "reject": None,
+            "alerts": msg,
         })
     print(
         f"igg_top — {len(by_rank)}/{len(endpoints)} rank(s) at "

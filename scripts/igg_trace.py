@@ -8,9 +8,12 @@ their honesty bound in ``otherData.clock_alignment``)::
 
     python scripts/igg_trace.py merge RUN_DIR -o merged.json
     python scripts/igg_trace.py merge RUN_DIR --device -o merged.json
+    python scripts/igg_trace.py merge RUN_DIR --per-epoch -o m.json
     python scripts/igg_trace.py merge trace.p0.json trace.p1.json -o m.json
     python scripts/igg_trace.py validate merged.json
     python scripts/igg_trace.py summarize RUN_DIR
+    python scripts/igg_trace.py request TRACE_ID RUN_DIR [-o req.json]
+    python scripts/igg_trace.py export RUN_DIR --otlp -o spans.otlp.json
 
 ``--device`` additionally joins each rank's profiler capture
 (``profile.p<rank>.json`` capture metas written by the ``IGG_PROFILE``
@@ -24,6 +27,17 @@ namespace with the anchor uncertainty recorded in
 p50/p99, max) over one or more per-rank dumps — the quick look that no
 longer requires loading Perfetto.  Load ``merged.json`` at
 https://ui.perfetto.dev (or chrome://tracing).
+
+``request TRACE_ID`` reconstructs ONE request's causal tree from any set
+of dumps — across pools, generations and re-routes (supervised restarts
+leave ``trace.g<gen>.p<rank>.json`` dumps; directories pick those up
+too) — printing the tree and its critical-path latency attribution,
+writing the request-highlighted Chrome view with ``-o`` and the OTLP/JSON
+slice with ``--otlp``.  A loud ``INCOMPLETE`` banner fires when any
+contributing ring dropped spans.  ``export --otlp`` ships every closed
+span as byte-stable OTLP/JSON (the Jaeger/Tempo ingest shape).
+``merge --per-epoch`` merges a multi-generation dump dir as one trace
+(one pid band per (generation, epoch) group) instead of refusing it.
 Exit codes: 0 ok, 1 invalid trace, 2 bad input/usage.
 """
 
@@ -43,11 +57,15 @@ if REPO not in sys.path:
 
 def _expand(inputs: list[str]) -> list[str]:
     """Trace files from a mix of files and directories (a directory means
-    every ``trace.p*.json`` in it)."""
+    every ``trace.p*.json`` in it, plus the generation-suffixed
+    ``trace.g<gen>.p*.json`` dumps a supervised restart leaves)."""
     paths: list[str] = []
     for item in inputs:
         if os.path.isdir(item):
-            found = sorted(glob.glob(os.path.join(item, "trace.p*.json")))
+            found = sorted(
+                glob.glob(os.path.join(item, "trace.p*.json"))
+                + glob.glob(os.path.join(item, "trace.g*.p*.json"))
+            )
             if not found:
                 raise FileNotFoundError(
                     f"{item}: no trace.p*.json files (run with "
@@ -64,7 +82,7 @@ def cmd_merge(args) -> int:
 
     try:
         paths = _expand(args.inputs)
-        doc = tracing.merge_trace_files(paths)
+        doc = tracing.merge_trace_files(paths, per_epoch=args.per_epoch)
         if args.device:
             from implicitglobalgrid_tpu.utils import profiling
 
@@ -150,6 +168,133 @@ def cmd_summarize(args) -> int:
     return 0
 
 
+def render_request_tree(tree: dict) -> str:
+    """Indented causal-tree text: one line per span with rank/gen
+    provenance and duration — the terminal view of `tracing.request_tree`
+    (golden-shaped by tests/test_request_tracing.py)."""
+    lines = [
+        f"trace {tree['trace_id']}: {tree['spans']} span(s), "
+        f"rank(s) {tree['ranks']}, gen(s) {tree['gens'] or '-'}"
+    ]
+
+    def _walk(nodes, depth):
+        for n in nodes:
+            where = f"rank {n['rank']}"
+            if n.get("gen") is not None:
+                where += f" gen {n['gen']}"
+            lines.append(
+                f"{'  ' * depth}- {n['name']}  [{where}]  "
+                f"{n['dur_s'] * 1e3:.3f}ms"
+            )
+            _walk(n["children"], depth + 1)
+
+    _walk(tree.get("roots", ()), 1)
+    return "\n".join(lines)
+
+
+def render_critical_path(cp: dict) -> str:
+    """Latency-attribution table over `tracing.critical_path` output."""
+    lines = [f"critical path: total {cp['total_s'] * 1e3:.3f}ms"]
+    for seg, v in cp["segments"].items():
+        lines.append(
+            f"  {seg:<12} {v['s'] * 1e3:>10.3f}ms {v['share'] * 100:>6.1f}%"
+        )
+    return "\n".join(lines)
+
+
+def cmd_request(args) -> int:
+    from implicitglobalgrid_tpu.utils import tracing
+
+    try:
+        paths = _expand(args.inputs)
+        docs = [tracing._load_rank_trace(os.fspath(p)) for p in paths]
+    except (OSError, ValueError) as e:
+        print(f"igg_trace: {e}", file=sys.stderr)
+        return 2
+    tree = tracing.request_tree(docs, args.trace_id)
+    if not tree["spans"]:
+        print(
+            f"igg_trace: no spans for trace {args.trace_id} in "
+            f"{len(docs)} dump(s).",
+            file=sys.stderr,
+        )
+        return 2
+    if tree["incomplete"]:
+        # the ring evicted spans somewhere: the tree below is silently
+        # partial and the reader must know before trusting it
+        print(
+            f"igg_trace: INCOMPLETE — contributing dump(s) dropped "
+            f"{tree['dropped']} span(s) to ring overflow; raise "
+            f"IGG_TRACE_RING and re-run for a full tree.",
+            file=sys.stderr,
+        )
+    print(render_request_tree(tree))
+    print(render_critical_path(tracing.critical_path(tree)))
+    if args.output:
+        view = tracing.request_chrome_trace(tree)
+        problems = tracing.validate_chrome_trace(view)
+        if problems:
+            for p in problems:
+                print(f"igg_trace: INVALID request view: {p}",
+                      file=sys.stderr)
+            return 1
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(json.dumps(view))
+        print(
+            f"igg_trace: wrote {args.output} (request-highlighted Chrome "
+            f"view) — load it at https://ui.perfetto.dev",
+            file=sys.stderr,
+        )
+    if args.otlp:
+        out = tracing.otlp_trace(docs, trace_id=args.trace_id)
+        problems = tracing.validate_otlp(out)
+        if problems:
+            for p in problems:
+                print(f"igg_trace: INVALID OTLP export: {p}",
+                      file=sys.stderr)
+            return 1
+        with open(args.otlp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(out, sort_keys=True, separators=(",", ":")))
+        print(f"igg_trace: wrote {args.otlp} (OTLP/JSON)", file=sys.stderr)
+    return 0
+
+
+def cmd_export(args) -> int:
+    from implicitglobalgrid_tpu.utils import tracing
+
+    try:
+        paths = _expand(args.inputs)
+        docs = [tracing._load_rank_trace(os.fspath(p)) for p in paths]
+    except (OSError, ValueError) as e:
+        print(f"igg_trace: {e}", file=sys.stderr)
+        return 2
+    out = tracing.otlp_trace(docs, trace_id=args.trace_id)
+    problems = tracing.validate_otlp(out)
+    if problems:
+        for p in problems:
+            print(f"igg_trace: INVALID OTLP export: {p}", file=sys.stderr)
+        return 1
+    # byte-stable serialization: same dumps, same bytes (the golden-pin
+    # contract — a collector diff means the data changed, not the tool)
+    body = json.dumps(out, sort_keys=True, separators=(",", ":"))
+    if args.output == "-":
+        print(body)
+    else:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(body)
+        nspans = sum(
+            len(ss["spans"])
+            for rs in out["resourceSpans"]
+            for ss in rs["scopeSpans"]
+        )
+        print(
+            f"igg_trace: wrote {args.output}: {nspans} OTLP span(s) from "
+            f"{len(docs)} dump(s)",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def cmd_validate(args) -> int:
     from implicitglobalgrid_tpu.utils import tracing
 
@@ -183,6 +328,11 @@ def main(argv=None) -> int:
                     help="join each rank's IGG_PROFILE capture "
                          "(profile.p*.json metas in the input dirs) as "
                          "device-op tracks on the rank pids")
+    mp.add_argument("--per-epoch", action="store_true",
+                    help="merge a multi-generation dump dir (supervised "
+                         "restarts) as one trace: one pid band per "
+                         "(generation, epoch) group instead of refusing "
+                         "the set")
     vp = sub.add_parser("validate", help="check a merged Chrome trace")
     vp.add_argument("trace")
     sp = sub.add_parser(
@@ -192,11 +342,39 @@ def main(argv=None) -> int:
                     help="trace.pN.json files and/or directories")
     sp.add_argument("--json", action="store_true",
                     help="machine-readable stats instead of the table")
+    rp = sub.add_parser(
+        "request",
+        help="reconstruct one request's causal tree across dumps",
+    )
+    rp.add_argument("trace_id", help="the request's 32-hex trace id")
+    rp.add_argument("inputs", nargs="+",
+                    help="trace.pN.json files and/or directories (any mix "
+                         "of pools/generations)")
+    rp.add_argument("-o", "--output", default=None,
+                    help="write the request-highlighted Chrome view here")
+    rp.add_argument("--otlp", default=None, metavar="PATH",
+                    help="write the request's OTLP/JSON slice here")
+    ep = sub.add_parser(
+        "export", help="OTLP/JSON export of every closed span"
+    )
+    ep.add_argument("inputs", nargs="+",
+                    help="trace.pN.json files and/or directories")
+    ep.add_argument("-o", "--output", default="-",
+                    help="OTLP/JSON path ('-' = stdout)")
+    ep.add_argument("--otlp", action="store_true",
+                    help="accepted for symmetry; OTLP/JSON is the only "
+                         "export format")
+    ep.add_argument("--trace-id", default=None,
+                    help="restrict the export to one request's spans")
     args = ap.parse_args(argv)
     if args.cmd == "merge":
         return cmd_merge(args)
     if args.cmd == "summarize":
         return cmd_summarize(args)
+    if args.cmd == "request":
+        return cmd_request(args)
+    if args.cmd == "export":
+        return cmd_export(args)
     return cmd_validate(args)
 
 

@@ -679,8 +679,27 @@ def child_fleet_pool_main(args) -> int:
             return step(*state)
 
         loop._step = doctored
+    # Periodic trace dumps (ISSUE 19): the chaos SIGKILL takes this
+    # process's span ring with it, so the request-tree reconstruction
+    # reads the last atomically-published trace.g<gen>.p0.json instead.
+    import threading as _threading
+
+    stop_dumper = _threading.Event()
+
+    def _trace_dumper():
+        while not stop_dumper.wait(0.25):
+            try:
+                igg.dump_trace()
+            except Exception:  # noqa: BLE001 — a dump must never kill serving
+                pass
+
+    _threading.Thread(
+        target=_trace_dumper, name="igg-trace-dumper", daemon=True
+    ).start()
     fd = FrontDoor(loop)
     outcome = fd.serve_rounds(idle_sleep=0.02)
+    stop_dumper.set()
+    igg.dump_trace()  # final flush: the shutdown path's spans
     fd.close()
     igg.finalize_global_grid()
     print(f"SOAK FLEET POOL {outcome}", flush=True)
@@ -2116,13 +2135,19 @@ def supervise_fleet(args) -> bool:
        oracle, zero failed requests, the ``fleet.detect`` →
        ``fleet.reroute`` → ``fleet.recovered`` order verified from the
        orchestrator's events.jsonl and the respawned pool's per-pool log
-       carrying the BUMPED generation;
+       carrying the BUMPED generation; additionally (ISSUE 19) every
+       admitted request's causal tree reconstructs from the pools'
+       periodic trace dumps + the orchestrator's dump — door→result
+       spans present, re-routed requests carrying the detect→reroute
+       hop, both generations of the chaos-killed pool contributing
+       spans, and the OTLP/Chrome exports schema-clean;
     2. a healthy canary serving real traffic promotes after the streak
        and its config overlay spreads to the seed specs;
     3. a doctored-slow canary (``--round-sleep``) breaches the round-p99
        SLO bar and rolls back through quarantine — the bad overlay never
        spreads.
     """
+    import glob as _glob
     import json as _json
     import shutil
     import time as _time
@@ -2131,6 +2156,7 @@ def supervise_fleet(args) -> bool:
         sys.path.insert(0, REPO)
     from implicitglobalgrid_tpu import fleet as flt
     from implicitglobalgrid_tpu.utils import telemetry as tele
+    from implicitglobalgrid_tpu.utils import tracing as trc
 
     workdir = args.workdir
     fleet_dir = os.path.join(workdir, "fleet_run")
@@ -2212,8 +2238,11 @@ def supervise_fleet(args) -> bool:
         if code != 202:
             failed.append((tenant, ic, ms, code, body))
             return None
-        accepted[body["request_id"]] = {"tenant": tenant, "ic": ic,
-                                        "ms": ms, "pool": body["pool"]}
+        route = router.routes.get(body["request_id"]) or {}
+        accepted[body["request_id"]] = {
+            "tenant": tenant, "ic": ic, "ms": ms, "pool": body["pool"],
+            "trace_id": (route.get("trace") or {}).get("trace_id"),
+        }
         return body
 
     def _poll_done():
@@ -2244,6 +2273,32 @@ def supervise_fleet(args) -> bool:
         if body is None:
             return _fail(f"submit refused: {failed}")
         victim = body["pool"]
+        long_tid = accepted[body["request_id"]]["trace_id"]
+        # Hold the chaos kill until the victim's periodic trace dump has
+        # published a span of the long job: the SIGKILL erases the ring,
+        # so the tree reconstruction reads the pool's LAST dump — which
+        # must already carry the request's gen-0 spans (ISSUE 19).
+        victim_tele = os.path.join(fleet_dir, victim, "telemetry")
+        dump_deadline = _time.monotonic() + min(30.0, args.timeout)
+        seen = long_tid is None  # tracing off: skip the hold
+        while not seen and _time.monotonic() < dump_deadline:
+            for p in _glob.glob(
+                os.path.join(victim_tele, "trace.g*.p*.json")
+            ):
+                try:
+                    with open(p) as f:
+                        pool_doc = _json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if any(trc._trace_match(s.get("args"), long_tid)[0]
+                       for s in pool_doc.get("spans", ())):
+                    seen = True
+                    break
+            if not seen:
+                _time.sleep(0.1)
+        if not seen:
+            return _fail("the victim pool never published a trace dump "
+                         "carrying the long job's spans")
         fc.handles[victim].kill()  # chaos: SIGKILL one whole failure domain
         # the door stays open THROUGH the outage (failover, not 5xx)
         for t in during_outage:
@@ -2288,6 +2343,133 @@ def supervise_fleet(args) -> bool:
         if not {0, 1} <= gens:
             return _fail(f"victim pool log gens {sorted(gens)}: the bumped "
                          f"generation never reached the per-pool log")
+
+        # -- ISSUE 19: request-tree reconstruction ----------------------
+        # Every admitted request must reconstruct into ONE causal tree
+        # from the pools' periodic dumps + the orchestrator's own dump:
+        # door→result spans present, re-routed requests carrying the
+        # detect→reroute hop, and the victim pool contributing spans from
+        # BOTH its generations (gen 0 pre-kill serving; gen 1 via a
+        # post-recovery request routed onto the respawned incarnation).
+        post_rid = None
+        deadline = _time.monotonic() + args.timeout
+        for _attempt in range(8):
+            body = _submit("tA", 1.0, steps)
+            if body is None:
+                return _fail(f"post-recovery submit refused: {failed}")
+            if body["pool"] == victim:
+                post_rid = body["request_id"]
+                break
+            _poll_done()
+            _time.sleep(0.2)
+        if post_rid is None:
+            return _fail(f"no post-recovery submit ever routed onto the "
+                         f"respawned pool {victim!r} (least-loaded routing "
+                         f"kept avoiding it)")
+        while _time.monotonic() < deadline:
+            fc.poll_once()
+            _poll_done()
+            if len(done) == len(accepted):
+                break
+            _time.sleep(0.1)
+        if len(done) != len(accepted):
+            return _fail("post-recovery request(s) never completed")
+        rerouted = sorted({
+            tid for s in trc.span_records()
+            if s["name"] == "igg.fleet.detect"
+            for tid in (s.get("args") or {}).get("trace_ids", ())
+        })
+        if not rerouted:
+            return _fail("the fleet.detect span carries no trace ids: the "
+                         "in-flight victim requests left no causal link")
+        trc.dump_trace(tele_dir)  # route/detect/reroute spans live HERE
+
+        def _load_dumps(d):
+            docs = []
+            for pat in ("trace.p*.json", "trace.g*.p*.json"):
+                for p in sorted(_glob.glob(os.path.join(d, pat))):
+                    try:
+                        docs.append(trc._load_rank_trace(p))
+                    except (OSError, ValueError):
+                        pass  # a dump mid-publish: the retry loop re-reads
+            return docs
+
+        def _span_names(tree):
+            names = set()
+
+            def walk(ns):
+                for nd in ns:
+                    names.add(nd["name"])
+                    walk(nd["children"])
+
+            walk(tree["roots"])
+            return names
+
+        # the pools dump every ~0.25 s: poll until the final round spans
+        # land on disk (bounded — a persistent hole is a real failure)
+        problems = ["dumps not read yet"]
+        all_docs: list = []
+        check_deadline = _time.monotonic() + 20.0
+        while problems and _time.monotonic() < check_deadline:
+            problems = []
+            victim_docs = _load_dumps(
+                os.path.join(fleet_dir, victim, "telemetry")
+            )
+            all_docs = list(victim_docs)
+            for pname in ("a", "b"):
+                if pname != victim:
+                    all_docs += _load_dumps(
+                        os.path.join(fleet_dir, pname, "telemetry")
+                    )
+            all_docs += _load_dumps(tele_dir)
+            victim_gens: set = set()
+            for fid, meta in accepted.items():
+                tid = meta.get("trace_id")
+                if not tid:
+                    problems.append(f"{fid}: no trace context on its route")
+                    continue
+                tree = trc.request_tree(all_docs, tid)
+                names = _span_names(tree)
+                if (not tree["spans"]
+                        or "igg.frontdoor.request" not in names
+                        or "igg.fleet.route" not in names):
+                    problems.append(
+                        f"{fid}: tree incomplete "
+                        f"(spans={tree['spans']}, names={sorted(names)})"
+                    )
+                if tid in rerouted and not (
+                    {"igg.fleet.detect", "igg.fleet.reroute"} <= names
+                ):
+                    problems.append(
+                        f"{fid}: re-routed but its tree lacks the "
+                        f"detect→reroute hop ({sorted(names)})"
+                    )
+                victim_gens |= set(
+                    trc.request_tree(victim_docs, tid)["gens"]
+                )
+            if not {0, 1} <= victim_gens:
+                problems.append(
+                    f"victim-pool generations in the trees: "
+                    f"{sorted(victim_gens)} — both generations of the "
+                    f"chaos-killed pool must contribute spans"
+                )
+            if problems:
+                _time.sleep(0.5)
+        if problems:
+            return _fail("request-tree check: " + "; ".join(problems[:4]))
+        # the same dumps must ship schema-clean (what igg_trace.py
+        # request/export would emit for these requests)
+        otlp_problems = trc.validate_otlp(trc.otlp_trace(all_docs))
+        if otlp_problems:
+            return _fail(f"OTLP export not schema-clean: "
+                         f"{otlp_problems[:3]}")
+        view = trc.request_chrome_trace(
+            trc.request_tree(all_docs, rerouted[0])
+        )
+        view_problems = trc.validate_chrome_trace(view)
+        if view_problems:
+            return _fail(f"request Chrome view invalid: "
+                         f"{view_problems[:3]}")
 
         # -- canary legs ------------------------------------------------
         from implicitglobalgrid_tpu.fleet.router import pool_health_view
@@ -2407,6 +2589,9 @@ def supervise_fleet(args) -> bool:
     record = {
         "requests": len(accepted),
         "rerouted_pool": victim,
+        "traced_requests": sum(
+            1 for m in accepted.values() if m.get("trace_id")
+        ),
         "canary": {"promoted": "canary-good", "rolled_back": "canary-bad"},
     }
     with open(os.path.join(workdir, "fleet_soak.json"), "w") as f:
@@ -2415,7 +2600,9 @@ def supervise_fleet(args) -> bool:
         "fleet", True,
         f"{len(accepted)} requests, pool {victim!r} chaos-killed -> "
         f"detect/reroute/recovered with zero failed requests, all digests "
-        f"== oracle; canary promote + doctored-slow rollback (breach=slo)",
+        f"== oracle; every request's causal tree reconstructed across "
+        f"pools/generations (detect→reroute hop + both victim gens); "
+        f"canary promote + doctored-slow rollback (breach=slo)",
     )
 
 

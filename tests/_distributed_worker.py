@@ -373,14 +373,31 @@ for b, oracle in ((0, sA), (1, sB)):
 # Mid-flight serving on the 2-process grid: 1 slot, 2 requests — the
 # second member must be admitted into the slot the first one freed, with
 # every rank taking the identical admit/retire decisions (the per-member
-# finite probe is replicated by construction).
+# finite probe is replicated by construction).  Both requests carry a
+# request-scoped trace context (ISSUE 19): every rank's round spans must
+# tag the active member's trace_id, so one causal tree reconstructs from
+# EITHER rank's dump even though the request entered at a single door.
+from implicitglobalgrid_tpu.utils import tracing as _trc
+
+_tid0, _tid1 = "ab" * 16, "cd" * 16
 _loop = ServingLoop(diffusion3d, params2, capacity=1, steps_per_round=1)
-_m0 = _loop.submit(Request(state=sA, max_steps=1, tenant="r0"))
-_m1 = _loop.submit(Request(state=sB, max_steps=1, tenant="r1"))
+_m0 = _loop.submit(Request(state=sA, max_steps=1, tenant="r0",
+                           trace={"trace_id": _tid0, "span_id": "0a" * 8}))
+_m1 = _loop.submit(Request(state=sB, max_steps=1, tenant="r1",
+                           trace={"trace_id": _tid1, "span_id": "0b" * 8}))
 _res = _loop.run(max_rounds=6)
 assert sorted(_res) == [_m0, _m1], _res
 assert all(r.status == "completed" and r.steps == 1 for r in _res.values())
 assert _loop.rounds == 2, _loop.rounds  # slot reuse = one round per member
+_round_tids = set()
+for _s in _trc.span_records():
+    if _s["name"] == "igg.serving.round":
+        for _t in (_tid0, _tid1):
+            if _trc._trace_match(_s.get("args"), _t)[0]:
+                _round_tids.add(_t)
+assert _round_tids == {_tid0, _tid1}, (
+    f"rank {pid} round spans lost request trace contexts: {_round_tids}"
+)
 
 # --- Autotuned config over the broadcast host transport (ISSUE 13): rank 0
 # holds a seeded winner cache, rank 1 an EMPTY one — the deliberately

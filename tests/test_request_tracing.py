@@ -1,0 +1,587 @@
+"""Request-scoped distributed tracing tests (ISSUE 19; docs/observability.md).
+
+Covers the W3C ``traceparent`` codec and its adoption/minting matrix at
+the door (inbound context wins, head sampling only gates MINTED traces),
+the context-propagation API (``parent=`` chaining, ambient `use_context`,
+the serving round's multi-request ``trace_ids`` form), the full loopback
+round trip (submit with a ``traceparent`` header → every response echoes
+the ledgered context → the round spans tag the request → `request_tree`
+reconstructs the causal chain), the critical-path latency attribution on
+a hand-computable fixture, the byte-stable OTLP/JSON export against a
+checked-in golden, the per-epoch merge over a restart-shaped dump dir,
+the span-ring overflow honesty chain (counter → ``dropped`` field →
+``incomplete`` tree → the CLI's INCOMPLETE banner), the ``/spans``
+liveplane filters + oldest-in-flight age, and the pinned zero-overhead
+contracts (``IGG_TELEMETRY=0`` and ``IGG_TRACE_SAMPLE=0``).  The real
+multi-pool / restart leg is the soak ``fleet`` drill
+(`scripts/soak.py`), whose tree check replays all of this across
+processes and generations.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import implicitglobalgrid_tpu as igg
+from implicitglobalgrid_tpu.models import diffusion3d
+from implicitglobalgrid_tpu.serving import FrontDoor, Request, ServingLoop
+from implicitglobalgrid_tpu.utils import liveplane as lp
+from implicitglobalgrid_tpu.utils import telemetry as tele
+from implicitglobalgrid_tpu.utils import tracing
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_repo = os.path.dirname(_here)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    for knob in ("IGG_TRACE_SAMPLE", "IGG_TRACE_RING", "IGG_GENERATION",
+                 "IGG_METRICS_PORT", "IGG_SERVE_PORT"):
+        monkeypatch.delenv(knob, raising=False)
+    tele.reset()
+    tracing.reset()
+    lp.reset()
+    yield
+    lp.reset()
+    tele.reset()
+    tracing.reset()
+
+
+NX = 8
+TID = "ab" * 16
+SID = "cd" * 8
+
+
+def _pool(capacity=2):
+    igg.init_global_grid(NX, NX, NX, quiet=True)
+    _, params = diffusion3d.setup(NX, NX, NX, init_grid=False)
+    return ServingLoop(diffusion3d, params, capacity=capacity,
+                      steps_per_round=1)
+
+
+def _member(scale=1.0):
+    state, _ = diffusion3d.setup(NX, NX, NX, init_grid=False, ic_scale=scale)
+    return state
+
+
+def _post(port, path, doc, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode() or "{}"), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}"), dict(e.headers)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, json.loads(r.read().decode() or "{}"), dict(r.headers)
+
+
+# -- the traceparent codec ----------------------------------------------------
+
+
+def test_parse_traceparent_matrix():
+    hdr = f"00-{TID}-{SID}-01"
+    assert tracing.parse_traceparent(hdr) == {"trace_id": TID, "span_id": SID}
+    # tolerated variation: uppercase + surrounding whitespace, extra flags
+    assert tracing.parse_traceparent(f"  00-{TID.upper()}-{SID}-00  ") == {
+        "trace_id": TID, "span_id": SID,
+    }
+    # the W3C "restart the trace" shapes all map to None
+    for bad in (
+        None, "", "garbage", "00-short-" + SID + "-01",
+        f"00-{TID}-{'0' * 16}-01",           # all-zero span id
+        f"00-{'0' * 32}-{SID}-01",           # all-zero trace id
+        f"ff-{TID}-{SID}-01",                # forbidden version
+        f"zz-{TID}-{SID}-01",                # non-hex version
+        f"00-{'x' * 32}-{SID}-01",           # non-hex trace id
+    ):
+        assert tracing.parse_traceparent(bad) is None, bad
+    assert tracing.format_traceparent(
+        {"trace_id": TID, "span_id": SID}
+    ) == hdr
+
+
+def test_trace_span_context_chaining_and_ambient():
+    # explicit parent: the span mints its own id chained under the parent
+    # and becomes the ambient parent of anything nested
+    with tracing.trace_span("outer", parent={"trace_id": TID,
+                                             "span_id": SID}):
+        inner_ctx = tracing.current_context()
+        assert inner_ctx["trace_id"] == TID
+        assert inner_ctx["span_id"] != SID
+        with tracing.trace_span("inner"):
+            pass
+    assert tracing.current_context() is None  # no leak
+    inner, outer = tracing.span_records()
+    assert outer["args"]["trace_id"] == TID
+    assert outer["args"]["parent_id"] == SID
+    assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+    # the multi-request (serving round) form tags ids without re-minting
+    with tracing.use_context({"trace_ids": [TID, "ef" * 16]}):
+        with tracing.trace_span("round"):
+            pass
+    rec = tracing.span_records()[-1]
+    assert rec["args"]["trace_ids"] == [TID, "ef" * 16]
+    assert "span_id" not in rec["args"]
+
+
+# -- sampling + zero-overhead pins --------------------------------------------
+
+
+def test_sample_zero_is_the_pinned_no_context_path(monkeypatch):
+    monkeypatch.setenv("IGG_TRACE_SAMPLE", "0")
+    assert tracing.should_sample() is False
+    loop = _pool(capacity=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        code, body, hdrs = fd.handle_submit({
+            "tenant": "tA", "model": "diffusion3d",
+            "params": {"max_steps": 1},
+        })
+        # no minted context: no header, no ledgered trace, no submit span
+        assert code == 202 and hdrs == {}
+        assert fd._requests[body["request_id"]]["trace"] is None
+        assert fd.trace_header(body["request_id"]) is None
+        assert not [s for s in tracing.span_records()
+                    if s["name"].startswith("igg.frontdoor.")]
+        # an INBOUND context is never re-sampled — upstream already decided
+        code, body, hdrs = fd.handle_submit(
+            {"tenant": "tA", "model": "diffusion3d",
+             "params": {"max_steps": 1}},
+            traceparent=f"00-{TID}-{SID}-01",
+        )
+        got = tracing.parse_traceparent(hdrs["traceparent"])
+        assert got["trace_id"] == TID and got["span_id"] != SID
+        rec = fd._requests[body["request_id"]]["trace"]
+        assert rec["trace_id"] == TID and rec["parent_id"] == SID
+    finally:
+        fd.close()
+
+
+def test_telemetry_off_is_pure_passthrough(monkeypatch):
+    monkeypatch.setenv("IGG_TELEMETRY", "0")
+    assert tracing.trace_span("x", parent={"trace_id": TID,
+                                           "span_id": SID}) \
+        is tracing.NOOP_SPAN
+    assert tracing.record_span("y", t0=0.0, dur=1.0,
+                               parent={"trace_id": TID}) is None
+    loop = _pool(capacity=1)
+    fd = FrontDoor(loop, port=0)
+    try:
+        hdr = f"00-{TID}-{SID}-01"
+        code, body, hdrs = fd.handle_submit(
+            {"tenant": "tA", "model": "diffusion3d",
+             "params": {"max_steps": 1}},
+            traceparent=hdr,
+        )
+        # the inbound header is echoed VERBATIM (no re-mint, no parse cost
+        # beyond the dict lookup) and nothing lands in the ring
+        assert code == 202 and hdrs == {"traceparent": hdr}
+        assert fd._requests[body["request_id"]]["trace"] is None
+        assert tracing.span_records() == []
+    finally:
+        fd.close()
+
+
+# -- the loopback round trip --------------------------------------------------
+
+
+def test_traceparent_roundtrip_through_loopback_frontdoor():
+    loop = _pool(capacity=2)
+    fd = FrontDoor(loop, port=0)
+    try:
+        code, body, hdrs = _post(
+            fd.port, "/v1/submit",
+            {"tenant": "tA", "model": "diffusion3d",
+             "params": {"max_steps": 2, "ic_scale": 1.1}},
+            headers={"traceparent": f"00-{TID}-{SID}-01"},
+        )
+        assert code == 202
+        rid = body["request_id"]
+        echo = tracing.parse_traceparent(hdrs["traceparent"])
+        assert echo["trace_id"] == TID        # adopted, not re-minted
+        assert echo["span_id"] != SID         # the door's own request span
+        # the in-flight ledger drives the oldest-request-age gauge
+        assert tele.snapshot()["gauges"][
+            "frontdoor.oldest_submitted_ts"] > 0
+        assert fd.serve_rounds(max_rounds=6) == "rounds"
+        code, view, hdrs = _get(fd.port, f"/v1/result/{rid}")
+        assert view["status"] == "done"
+        # EVERY response for a traced request carries the same context back
+        assert tracing.parse_traceparent(hdrs["traceparent"]) == echo
+        assert tele.snapshot()["gauges"][
+            "frontdoor.oldest_submitted_ts"] == 0  # nothing in flight
+        # one causal tree from this process's ring: door hops chained
+        # under the request span, rounds tagging the member context
+        doc = {
+            "schema": tracing.TRACE_SCHEMA, "rank": 0, "gen": None,
+            "dropped": tracing.spans_dropped(),
+            "clock_sync": tracing.clock_sync(),
+            "spans": tracing.span_records(),
+        }
+        tree = tracing.request_tree([doc], TID)
+        assert not tree["incomplete"]
+        req = [r for r in tree["roots"]
+               if r["name"] == "igg.frontdoor.request"]
+        assert len(req) == 1, tree["roots"]
+        assert req[0]["args"]["parent_id"] == SID  # chained to the caller
+        assert req[0]["args"]["span_id"] == echo["span_id"]
+
+        def _names(ns):
+            out = set()
+            for n in ns:
+                out.add(n["name"])
+                out |= _names(n["children"])
+            return out
+
+        names = _names(tree["roots"])
+        assert {"igg.frontdoor.request", "igg.frontdoor.submit",
+                "igg.frontdoor.admit", "igg.serving.round"} <= names
+        cp = tracing.critical_path(tree)
+        assert cp["total_s"] == pytest.approx(req[0]["dur_s"])
+        assert sum(v["share"] for v in cp["segments"].values()) \
+            == pytest.approx(1.0)
+    finally:
+        fd.close()
+
+
+def test_round_spans_carry_member_context_single_process():
+    loop = _pool(capacity=1)
+    mem = loop.submit(Request(state=_member(), max_steps=1, tenant="tA",
+                              trace={"trace_id": TID, "span_id": SID}))
+    res = loop.run(max_rounds=3)
+    assert res[mem].status == "completed"
+    rounds = [s for s in tracing.span_records()
+              if s["name"] == "igg.serving.round"
+              and tracing._trace_match(s.get("args"), TID)[0]]
+    assert rounds, "no round span tagged the traced member"
+    args = rounds[0]["args"]
+    assert TID in args["trace_ids"]
+    # the embedded member context names the request-side parent directly
+    assert tracing._trace_match(args, TID) == (True, SID)
+
+
+# -- request_tree + critical_path on a hand-computable fixture ----------------
+
+REQ, SUB, ADM, ADN = "aa" * 8, "bb" * 8, "cc" * 8, "dd" * 8
+
+
+def _fixture_docs():
+    """Two dumps, one request: the door on rank 0 (no generation), a pool
+    rank 1 under a supervisor (gen 0).  Wall intervals, in seconds from
+    t=1000: request [0,10], queue-wait [0,4] containing admission [0,1],
+    round [4,9] containing a 2s exchange [6,8] — so the attribution is
+    exactly queue_wait 3 / admission 1 / rounds 3 / exchange 2 / other 1.
+    """
+    door = {
+        "schema": tracing.TRACE_SCHEMA, "rank": 0, "pid": 101, "gen": None,
+        "dropped": 0,
+        "clock_sync": {"wall": 1000.0, "perf": 100.0, "uncertainty_s": 0.0,
+                       "epoch": 1, "barrier": False},
+        "spans": [
+            {"name": "igg.frontdoor.submit", "t0": 100.0, "dur": 0.5,
+             "args": {"trace_id": TID, "span_id": SUB, "parent_id": REQ,
+                      "request": "r000000"}},
+            {"name": "igg.serving.admission", "t0": 100.0, "dur": 1.0,
+             "args": {"trace_id": TID, "span_id": ADN, "parent_id": REQ,
+                      "tenant": "tA"}},
+            {"name": "igg.frontdoor.admit", "t0": 100.0, "dur": 4.0,
+             "args": {"trace_id": TID, "span_id": ADM, "parent_id": REQ,
+                      "request": "r000000"}},
+            {"name": "igg.frontdoor.request", "t0": 100.0, "dur": 10.0,
+             "args": {"trace_id": TID, "span_id": REQ, "request": "r000000",
+                      "tenant": "tA", "result": "completed"}},
+        ],
+    }
+    pool = {
+        "schema": tracing.TRACE_SCHEMA, "rank": 1, "pid": 202, "gen": 0,
+        "dropped": 0,
+        "clock_sync": {"wall": 1000.0, "perf": 500.0, "uncertainty_s": 0.0,
+                       "epoch": 1, "barrier": False},
+        "spans": [
+            {"name": "igg.serving.round", "t0": 504.0, "dur": 5.0,
+             "args": {"round": 3, "trace_ids": [TID],
+                      "members": [{"member": 0, "slot": 0, "tenant": "tA",
+                                   "trace": {"trace_id": TID,
+                                             "span_id": ADM}}]}},
+            {"name": "igg_halo_exchange", "t0": 506.0, "dur": 2.0,
+             "args": {"trace_ids": [TID]}},
+        ],
+    }
+    return [door, pool]
+
+
+def test_request_tree_parenting_across_dumps():
+    tree = tracing.request_tree(_fixture_docs(), TID)
+    assert tree["spans"] == 6
+    assert tree["ranks"] == [0, 1] and tree["gens"] == [0]
+    assert tree["dropped"] == 0 and tree["incomplete"] is False
+    # ONE root: the pool round chains under the door's admit span through
+    # its embedded member context — the edge that crosses the dumps
+    assert [r["name"] for r in tree["roots"]] == ["igg.frontdoor.request"]
+    req = tree["roots"][0]
+    assert sorted(c["name"] for c in req["children"]) == [
+        "igg.frontdoor.admit", "igg.frontdoor.submit",
+        "igg.serving.admission",
+    ]
+    adm = next(c for c in req["children"]
+               if c["name"] == "igg.frontdoor.admit")
+    assert [c["name"] for c in adm["children"]] == ["igg.serving.round"]
+    rnd = adm["children"][0]
+    # the exchange has no explicit parent: it nests by time containment
+    # under the smallest enclosing matching span of its OWN dump
+    assert [c["name"] for c in rnd["children"]] == ["igg_halo_exchange"]
+    assert rnd["t0_unix_s"] == pytest.approx(1004.0)
+    # a trace id nothing matches reconstructs to an explicitly-empty tree
+    empty = tracing.request_tree(_fixture_docs(), "99" * 16)
+    assert empty["spans"] == 0 and empty["roots"] == []
+
+
+def test_critical_path_segment_math():
+    cp = tracing.critical_path(tracing.request_tree(_fixture_docs(), TID))
+    assert cp["total_s"] == pytest.approx(10.0)
+    seg = {k: v["s"] for k, v in cp["segments"].items()}
+    # nested time charges the INNER segment exactly once: admission out of
+    # queue-wait, exchange out of the round
+    assert seg == {
+        "queue_wait": pytest.approx(3.0),
+        "admission": pytest.approx(1.0),
+        "reroute": pytest.approx(0.0),
+        "checkpoint": pytest.approx(0.0),
+        "exchange": pytest.approx(2.0),
+        "rounds": pytest.approx(3.0),
+        "other": pytest.approx(1.0),
+    }
+    assert cp["segments"]["rounds"]["share"] == pytest.approx(0.3)
+    assert sum(v["share"] for v in cp["segments"].values()) \
+        == pytest.approx(1.0)
+
+
+# -- OTLP export --------------------------------------------------------------
+
+
+def _otlp_bytes(docs, **kw):
+    out = tracing.otlp_trace(docs, **kw)
+    assert tracing.validate_otlp(out) == []
+    return json.dumps(out, sort_keys=True, separators=(",", ":"))
+
+
+def test_otlp_export_golden_byte_stable():
+    golden = os.path.join(_here, "data", "request_trace_otlp.golden.json")
+    body = _otlp_bytes(_fixture_docs())
+    assert body == _otlp_bytes(_fixture_docs())  # deterministic
+    with open(golden, encoding="utf-8") as f:
+        assert body == f.read().rstrip("\n"), (
+            "OTLP export changed shape — if deliberate, regenerate the "
+            "golden (see tests/data/request_trace_otlp.golden.json header "
+            "comment in git history)"
+        )
+    doc = json.loads(body)
+    spans = [s for rs in doc["resourceSpans"]
+             for ss in rs["scopeSpans"] for s in ss["spans"]]
+    assert len(spans) == 6
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["igg.frontdoor.request"]["kind"] == 2  # SERVER
+    assert by_name["igg.serving.round"]["kind"] == 1
+    assert by_name["igg.frontdoor.admit"]["parentSpanId"] == REQ
+    assert by_name["igg.frontdoor.request"]["startTimeUnixNano"] \
+        == str(int(1000.0 * 1e9))
+
+
+def test_otlp_request_slice_and_schema_rejections():
+    # the single-request slice keeps only matching spans, and the round
+    # span (matched through its member context) gains that parent edge
+    doc = json.loads(_otlp_bytes(_fixture_docs(), trace_id=TID))
+    spans = [s for rs in doc["resourceSpans"]
+             for ss in rs["scopeSpans"] for s in ss["spans"]]
+    assert all(s["traceId"] == TID for s in spans)
+    rnd = next(s for s in spans if s["name"] == "igg.serving.round")
+    assert rnd["parentSpanId"] == ADM
+    # the validator actually rejects breakage
+    bad = json.loads(_otlp_bytes(_fixture_docs()))
+    sp = bad["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    sp["traceId"] = "nope"
+    sp["endTimeUnixNano"] = "-"
+    problems = tracing.validate_otlp(bad)
+    assert any("bad traceId" in p for p in problems)
+    assert any("timestamps" in p for p in problems)
+    assert tracing.validate_otlp({}) == [
+        "resourceSpans is missing or not a list"
+    ]
+
+
+# -- per-epoch merge over a restart-shaped dump dir ---------------------------
+
+
+def test_per_epoch_merge_of_real_restart_dumps(monkeypatch, tmp_path):
+    """Two generations dumped by the REAL dump path into one telemetry
+    dir — the exact shape a supervised restart leaves.  The flat merge
+    must refuse (different barriers cannot share an aligned clock); the
+    per-epoch merge renders both generations as separate pid bands."""
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    paths = []
+    for gen, epoch in ((0, 1), (1, 2)):
+        monkeypatch.setenv("IGG_GENERATION", str(gen))
+        tracing.reset()
+        tracing.record_clock_sync(lambda: None, epoch=epoch)
+        with tracing.trace_span("igg.serving.round", round=gen,
+                                trace_ids=[TID]):
+            pass
+        p = igg.dump_trace()
+        assert p is not None and p.endswith(f"trace.g{gen}.p0.json")
+        paths.append(p)
+    with pytest.raises(ValueError, match="--per-epoch"):
+        tracing.merge_trace_files(paths)
+    merged = tracing.merge_trace_files(paths, per_epoch=True)
+    assert tracing.validate_chrome_trace(merged) == []
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert sorted({e["pid"] for e in xs}) \
+        == [0, tracing.EPOCH_PID_STRIDE]  # one band per generation
+    names = {e["args"]["name"]
+             for e in merged["traceEvents"] if e["ph"] == "M"}
+    assert any(n.endswith("gen 0") for n in names)
+    assert any(n.endswith("gen 1") for n in names)
+    groups = merged["otherData"]["clock_alignment"]["groups"]
+    assert [g["gen"] for g in groups] == ["0", "1"] or \
+        [g["gen"] for g in groups] == [0, 1]
+    # and the tree reconstructs ACROSS the generations from those dumps
+    docs = [tracing._load_rank_trace(p) for p in paths]
+    tree = tracing.request_tree(docs, TID)
+    assert tree["spans"] == 2 and len(tree["gens"]) == 2
+
+
+# -- ring overflow honesty ----------------------------------------------------
+
+
+def test_ring_overflow_counts_and_marks_trees_incomplete(
+    monkeypatch, tmp_path
+):
+    monkeypatch.setenv("IGG_TRACE_RING", "4")
+    monkeypatch.setenv("IGG_TELEMETRY_DIR", str(tmp_path))
+    for i in range(6):
+        with tracing.trace_span("filler", i=i):
+            pass
+    with tracing.trace_span("igg.serving.round", trace_ids=[TID]):
+        pass
+    assert tracing.spans_dropped() == 3
+    assert tele.snapshot()["counters"]["trace.spans_dropped_total"] == 3
+    path = igg.dump_trace()
+    doc = tracing._load_rank_trace(path)
+    assert doc["dropped"] == 3
+    tree = tracing.request_tree([doc], TID)
+    assert tree["spans"] == 1
+    assert tree["dropped"] == 3 and tree["incomplete"] is True
+
+
+# -- the igg_trace.py CLI -----------------------------------------------------
+
+
+def _cli(*argv, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_repo, env.get("PYTHONPATH")) if p
+    )
+    script = os.path.join(_repo, "scripts", "igg_trace.py")
+    return subprocess.run(
+        [sys.executable, script, *argv],
+        capture_output=True, text=True, env=env, timeout=timeout,
+    )
+
+
+def _write_fixture_dir(tmp_path, *, dropped=0):
+    docs = _fixture_docs()
+    docs[1]["dropped"] = dropped
+    names = ["trace.p0.json", "trace.g0.p1.json"]
+    for doc, name in zip(docs, names):
+        (tmp_path / name).write_text(json.dumps(doc))
+    return tmp_path
+
+
+def test_igg_trace_cli_request_tree_and_views(tmp_path):
+    d = _write_fixture_dir(tmp_path)
+    view = tmp_path / "req.json"
+    otlp = tmp_path / "req.otlp.json"
+    r = _cli("request", TID, str(d), "-o", str(view), "--otlp", str(otlp))
+    assert r.returncode == 0, r.stderr
+    assert "INCOMPLETE" not in r.stderr
+    assert f"trace {TID}: 6 span(s)" in r.stdout
+    assert "- igg.frontdoor.request  [rank 0]  10000.000ms" in r.stdout
+    assert "  - igg.serving.round  [rank 1 gen 0]" in r.stdout  # provenance
+    assert "critical path: total 10000.000ms" in r.stdout
+    assert "rounds" in r.stdout and "30.0%" in r.stdout
+    # the request-highlighted Chrome view validates and bands by (gen, rank)
+    vdoc = json.loads(view.read_text())
+    assert tracing.validate_chrome_trace(vdoc) == []
+    assert vdoc["otherData"]["request"]["trace_id"] == TID
+    # the OTLP slice is the same byte-stable artifact the library emits
+    assert otlp.read_text() == _otlp_bytes(_fixture_docs(), trace_id=TID)
+    # unknown trace id: a structured refusal, not an empty tree
+    r = _cli("request", "99" * 16, str(d))
+    assert r.returncode == 2 and "no spans for trace" in r.stderr
+
+
+def test_igg_trace_cli_incomplete_banner_and_export(tmp_path):
+    d = _write_fixture_dir(tmp_path, dropped=7)
+    r = _cli("request", TID, str(d))
+    assert r.returncode == 0, r.stderr
+    # the tree still prints, but NEVER as a silently-partial one
+    assert "INCOMPLETE" in r.stderr and "7 span(s)" in r.stderr
+    assert "IGG_TRACE_RING" in r.stderr
+    out = tmp_path / "spans.otlp.json"
+    r = _cli("export", str(d), "--otlp", "-o", str(out))
+    assert r.returncode == 0, r.stderr
+    assert "6 OTLP span(s) from 2 dump(s)" in r.stderr
+    doc = json.loads(out.read_text())
+    assert tracing.validate_otlp(doc) == []
+
+
+# -- liveplane: /spans filters + oldest in-flight age -------------------------
+
+
+def test_spans_endpoint_filters_by_name_and_request(monkeypatch):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    with tracing.use_context({"trace_id": TID, "span_id": SID}):
+        with tracing.trace_span("lp.traced", step=1):
+            pass
+    with tracing.trace_span("lp.other"):
+        pass
+    with tracing.trace_span("igg.serving.round", trace_ids=[TID]):
+        pass
+    port = lp.start_server().port
+    _, s, _ = _get(port, "/spans")
+    assert len(s["spans"]) == 3
+    _, s, _ = _get(port, "/spans?name=lp.")
+    assert sorted(x["name"] for x in s["spans"]) == ["lp.other", "lp.traced"]
+    _, s, _ = _get(port, f"/spans?request={TID}")
+    assert sorted(x["name"] for x in s["spans"]) \
+        == ["igg.serving.round", "lp.traced"]
+    _, s, _ = _get(port, f"/spans?name=round&request={TID}")
+    assert [x["name"] for x in s["spans"]] == ["igg.serving.round"]
+    _, s, _ = _get(port, "/spans?request=" + "99" * 16)
+    assert s["spans"] == []
+
+
+def test_healthz_reports_oldest_inflight_request_age(monkeypatch):
+    monkeypatch.setenv("IGG_METRICS_PORT", "0")
+    tele.gauge("serving.active_members").set(1)
+    tele.gauge("frontdoor.oldest_submitted_ts").set(time.time() - 5.0)
+    port = lp.start_server().port
+    _, h, _ = _get(port, "/healthz")
+    assert 4.0 <= h["serving"]["oldest_request_age_s"] <= 120.0
+    # gauge at 0 = nothing in flight: the key stays absent, not "age now"
+    tele.gauge("frontdoor.oldest_submitted_ts").set(0)
+    _, h, _ = _get(port, "/healthz")
+    assert "oldest_request_age_s" not in h["serving"]
